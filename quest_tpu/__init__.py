@@ -1,0 +1,60 @@
+"""quest_tpu: a TPU-native quantum simulation framework.
+
+A ground-up JAX/XLA/Pallas re-design with the full capability surface of the
+reference QuEST library (state-vectors + density matrices, ~140 API
+functions, distributed amplitude sharding): see SURVEY.md for the layer map
+and reference citations.
+
+Quick start::
+
+    import quest_tpu as qt
+
+    env = qt.createQuESTEnv()
+    q = qt.createQureg(3, env)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    print(qt.calcProbOfOutcome(q, 1, 1))   # 0.5
+
+The camelCase API mirrors the reference (QuEST.h) so existing QuEST users
+can switch directly; list arguments carry their own lengths, replacing the
+C API's explicit count parameters.
+"""
+
+from .precision import (
+    set_precision,
+    get_precision,
+    real_eps,
+    MAX_NUM_REGS_APPLY_ARBITRARY_PHASE,
+)
+from .validation import QuESTError
+from .qureg import Qureg, PauliHamil, DiagonalOp
+from .env import QuESTEnv
+from .qasm import QASMLogger
+from .api import *  # noqa: F401,F403
+from .api_ops import *  # noqa: F401,F403
+from .ops import phasefunc as _pf
+
+# enum phaseFunc (QuEST.h:231-234)
+NORM = _pf.NORM
+SCALED_NORM = _pf.SCALED_NORM
+INVERSE_NORM = _pf.INVERSE_NORM
+SCALED_INVERSE_NORM = _pf.SCALED_INVERSE_NORM
+SCALED_INVERSE_SHIFTED_NORM = _pf.SCALED_INVERSE_SHIFTED_NORM
+PRODUCT = _pf.PRODUCT
+SCALED_PRODUCT = _pf.SCALED_PRODUCT
+INVERSE_PRODUCT = _pf.INVERSE_PRODUCT
+SCALED_INVERSE_PRODUCT = _pf.SCALED_INVERSE_PRODUCT
+DISTANCE = _pf.DISTANCE
+SCALED_DISTANCE = _pf.SCALED_DISTANCE
+INVERSE_DISTANCE = _pf.INVERSE_DISTANCE
+SCALED_INVERSE_DISTANCE = _pf.SCALED_INVERSE_DISTANCE
+SCALED_INVERSE_SHIFTED_DISTANCE = _pf.SCALED_INVERSE_SHIFTED_DISTANCE
+
+# bitEncoding (QuEST.h:269)
+UNSIGNED = 0
+TWOS_COMPLEMENT = 1
+
+# pauliOpType (QuEST.h:96)
+PAULI_I, PAULI_X, PAULI_Y, PAULI_Z = 0, 1, 2, 3
+
+__version__ = "0.1.0"

@@ -1,0 +1,818 @@
+"""Public API: the TPU-native equivalent of the reference's dispatch layer
+(QuEST/src/QuEST.c) exposing the full ~140-function surface of QuEST.h.
+
+Every function follows the reference's dispatch shape (QuEST.c:177-186):
+validate -> kernel on the ket qubits -> if density matrix, conjugated twin
+kernel on the bra qubits (+numQubits shift; QuEST.c:8-10,181-183) -> QASM
+record.  Kernels are jit-compiled pure functions over the register's on-HBM
+amplitude array (quest_tpu.ops.*); the register object just re-binds its
+``amps`` handle, so a chain of API calls is a chain of donated in-place XLA
+updates.
+
+Semantic trap preserved (SURVEY.md §2.3): the ``apply*`` family
+(applyMatrix2/4/N, applyMultiControlledMatrixN, applyPauliSum/Hamil,
+applyPhaseFunc*, applyDiagonalOp) performs NO unitarity validation and NO
+density-matrix twin — on a density matrix it left-multiplies
+(QuEST.c:1074-1105).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import env as _env
+from . import rng as _rng
+from . import validation as V
+from .ops import calculations as C
+from .ops import cplx as CX
+from .ops import density as D
+from .ops import gatedefs as G
+from .ops import kernels as K
+from .ops import paulis as P
+from .ops import phasefunc as PF
+from .precision import complex_dtype, real_dtype, real_eps
+from .qureg import DiagonalOp, PauliHamil, Qureg
+
+# pauliOpType (QuEST.h:96)
+PAULI_I, PAULI_X, PAULI_Y, PAULI_Z = 0, 1, 2, 3
+# bitEncoding (QuEST.h:269)
+UNSIGNED, TWOS_COMPLEMENT = 0, 1
+
+# ---------------------------------------------------------------------------
+# Environment (QuEST.h:1851-1939)
+# ---------------------------------------------------------------------------
+
+createQuESTEnv = _env.create_quest_env
+destroyQuESTEnv = _env.destroy_quest_env
+syncQuESTEnv = _env.sync_quest_env
+syncQuESTSuccess = _env.sync_quest_success
+reportQuESTEnv = _env.report_quest_env
+getEnvironmentString = _env.get_environment_string
+seedQuEST = _env.seed_quest
+seedQuESTDefault = _env.seed_quest_default
+QuESTError = V.QuESTError
+
+
+def copyStateToGPU(qureg: Qureg) -> None:
+    """No-op: amplitudes are always device-resident (the reference GPU
+    backend keeps a host mirror it must sync, QuEST_gpu.cu:517-539)."""
+
+
+def copyStateFromGPU(qureg: Qureg) -> None:
+    """No-op: see copyStateToGPU."""
+
+
+def invalidQuESTInputError(errMsg: str, errFunc: str):
+    """Reference's overridable error hook (QuEST.h:5354); in Python the
+    equivalent is catching QuESTError."""
+    raise V.QuESTError(f"{errFunc}: {errMsg}")
+
+
+# ---------------------------------------------------------------------------
+# Register lifecycle (QuEST.c:36-76)
+# ---------------------------------------------------------------------------
+
+
+def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
+    V.validate_num_qubits(numQubits, "createQureg")
+    q = Qureg(numQubits, env, is_density_matrix=False)
+    q.amps = q.device_put(K.init_zero_state(q.num_amps_total, q.dtype))
+    return q
+
+
+def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
+    V.validate_num_qubits(numQubits, "createDensityQureg")
+    q = Qureg(numQubits, env, is_density_matrix=True)
+    q.amps = q.device_put(
+        K.init_classical_density(numQubits, 0, q.dtype)
+    )
+    return q
+
+
+def createCloneQureg(qureg: Qureg, env: _env.QuESTEnv) -> Qureg:
+    q = Qureg(qureg.num_qubits_represented, env, qureg.is_density_matrix)
+    q.amps = jnp.array(qureg.amps, copy=True)
+    return q
+
+
+def destroyQureg(qureg: Qureg, env: Optional[_env.QuESTEnv] = None) -> None:
+    qureg.amps = None
+
+
+def reportState(qureg: Qureg) -> None:
+    """Dump amplitudes to state_rank_0.csv (reference reportState,
+    QuEST_common.c:229-245)."""
+    amps = np.asarray(qureg.amps)
+    with open("state_rank_0.csv", "w") as f:
+        f.write("real, imag\n")
+        for re, im in zip(amps[0], amps[1]):
+            f.write(f"{re:.12f}, {im:.12f}\n")
+
+
+def reportStateToScreen(qureg: Qureg, env=None, reportRank: int = 0) -> None:
+    amps = np.asarray(qureg.amps)
+    print("Reporting state from rank 0:")
+    for re, im in zip(amps[0], amps[1]):
+        print(f"{re} {im}")
+
+
+def reportQuregParams(qureg: Qureg) -> None:
+    print(f"QUBITS:\nNumber of qubits is {qureg.num_qubits_represented}.")
+    print(f"Number of amps is {qureg.num_amps_total}.")
+    print(f"Number of amps per rank is {qureg.num_amps_per_chunk}.")
+
+
+def getNumQubits(qureg: Qureg) -> int:
+    return qureg.num_qubits_represented
+
+
+def getNumAmps(qureg: Qureg) -> int:
+    V.validate_state_vector(qureg, "getNumAmps")
+    return qureg.num_amps_total
+
+
+# ---------------------------------------------------------------------------
+# Matrix / operator structures (QuEST.c:1383-1602)
+# ---------------------------------------------------------------------------
+
+
+def createComplexMatrixN(numQubits: int) -> np.ndarray:
+    V.validate_num_qubits(numQubits, "createComplexMatrixN")
+    dim = 1 << numQubits
+    return np.zeros((dim, dim), dtype=np.complex128)
+
+
+def destroyComplexMatrixN(matrix) -> None:
+    pass
+
+
+def initComplexMatrixN(m: np.ndarray, reals, imags) -> None:
+    m[...] = np.asarray(reals, dtype=np.float64) + 1j * np.asarray(imags, np.float64)
+
+
+def getStaticComplexMatrixN(reals, imags) -> np.ndarray:
+    return np.asarray(reals, dtype=np.float64) + 1j * np.asarray(imags, np.float64)
+
+
+def createPauliHamil(numQubits: int, numSumTerms: int) -> PauliHamil:
+    V.validate_hamil_params(numQubits, numSumTerms, "createPauliHamil")
+    return PauliHamil(numQubits, numSumTerms)
+
+
+def destroyPauliHamil(hamil: PauliHamil) -> None:
+    pass
+
+
+def createPauliHamilFromFile(filename: str) -> PauliHamil:
+    """Text format: per line 'coeff code_0 code_1 ... code_{n-1}'
+    (reference parser, QuEST.c:1405-1488)."""
+    try:
+        with open(filename) as f:
+            lines = [ln.split() for ln in f if ln.strip()]
+    except OSError:
+        raise V.QuESTError(
+            f"createPauliHamilFromFile: Could not open file {filename}"
+        )
+    if not lines:
+        raise V.QuESTError("createPauliHamilFromFile: Empty Hamiltonian file.")
+    num_qubits = len(lines[0]) - 1
+    num_terms = len(lines)
+    V.validate_hamil_params(num_qubits, num_terms, "createPauliHamilFromFile")
+    h = PauliHamil(num_qubits, num_terms)
+    for t, toks in enumerate(lines):
+        if len(toks) != num_qubits + 1:
+            raise V.QuESTError(
+                "createPauliHamilFromFile: Inconsistent number of Pauli codes."
+            )
+        h.term_coeffs[t] = float(toks[0])
+        codes = [int(x) for x in toks[1:]]
+        V.validate_pauli_codes(codes, "createPauliHamilFromFile")
+        h.pauli_codes[t, :] = codes
+    return h
+
+
+def initPauliHamil(hamil: PauliHamil, coeffs, codes) -> None:
+    V.validate_hamil_params(hamil.num_qubits, hamil.num_sum_terms, "initPauliHamil")
+    codes = np.asarray(codes).reshape(hamil.num_sum_terms, hamil.num_qubits)
+    V.validate_pauli_codes(codes.ravel(), "initPauliHamil")
+    hamil.term_coeffs[:] = np.asarray(coeffs, dtype=np.float64)
+    hamil.pauli_codes[...] = codes
+
+
+def reportPauliHamil(hamil: PauliHamil) -> None:
+    for t in range(hamil.num_sum_terms):
+        codes = " ".join(str(int(c)) for c in hamil.pauli_codes[t])
+        print(f"{hamil.term_coeffs[t]:g}\t{codes}")
+
+
+def createDiagonalOp(numQubits: int, env: _env.QuESTEnv) -> DiagonalOp:
+    V.validate_num_qubits(numQubits, "createDiagonalOp")
+    return DiagonalOp(numQubits, env)
+
+
+def destroyDiagonalOp(op: DiagonalOp, env=None) -> None:
+    pass
+
+
+def syncDiagonalOp(op: DiagonalOp) -> None:
+    """No-op: the reference must mirror host arrays into
+    op.deviceOperator (QuEST.h:297); ours are always device-resident."""
+
+
+def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
+    rdt = real_dtype()
+    dim = 1 << op.num_qubits
+    sharding = (
+        op.env.amp_sharding()
+        if dim >= op.env.num_devices
+        else op.env.replicated_sharding()
+    )
+    op.real = jax.device_put(jnp.asarray(np.asarray(reals), rdt), sharding)
+    op.imag = jax.device_put(jnp.asarray(np.asarray(imags), rdt), sharding)
+
+
+def setDiagonalOpElems(op: DiagonalOp, startInd: int, reals, imags, numElems: int) -> None:
+    reals = np.asarray(reals, dtype=np.float64)[:numElems]
+    imags = np.asarray(imags, dtype=np.float64)[:numElems]
+    if startInd < 0 or startInd + numElems > (1 << op.num_qubits):
+        raise V.QuESTError("setDiagonalOpElems: Invalid element indices.")
+    op.real = op.real.at[startInd:startInd + numElems].set(reals.astype(op.real.dtype))
+    op.imag = op.imag.at[startInd:startInd + numElems].set(imags.astype(op.imag.dtype))
+
+
+def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
+    """Requires an all-I/Z Hamiltonian; diagonal_d = sum_t c_t prod_q
+    (-1)^{z_q(d)} (reference agnostic_initDiagonalOpFromPauliHamil,
+    QuEST_cpu.c:4188-4227)."""
+    V.validate_pauli_hamil(hamil, "initDiagonalOpFromPauliHamil")
+    if op.num_qubits != hamil.num_qubits:
+        raise V.QuESTError(
+            "initDiagonalOpFromPauliHamil: PauliHamil and DiagonalOp dimensions differ."
+        )
+    if np.any((hamil.pauli_codes != PAULI_I) & (hamil.pauli_codes != PAULI_Z)):
+        raise V.QuESTError(
+            "initDiagonalOpFromPauliHamil: The PauliHamil contained operators other than PAULI_Z and PAULI_I."
+        )
+    dim = 1 << op.num_qubits
+    idx = np.arange(dim, dtype=np.int64)
+    total = np.zeros(dim, dtype=np.float64)
+    for t in range(hamil.num_sum_terms):
+        signs = np.ones(dim, dtype=np.float64)
+        for q in range(hamil.num_qubits):
+            if hamil.pauli_codes[t, q] == PAULI_Z:
+                signs *= 1.0 - 2.0 * ((idx >> q) & 1)
+        total += hamil.term_coeffs[t] * signs
+    initDiagonalOp(op, total, np.zeros_like(total))
+
+
+def createDiagonalOpFromPauliHamilFile(filename: str, env: _env.QuESTEnv) -> DiagonalOp:
+    hamil = createPauliHamilFromFile(filename)
+    op = DiagonalOp(hamil.num_qubits, env)
+    initDiagonalOpFromPauliHamil(op, hamil)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# State initialisation (QuEST.h:1361-1559)
+# ---------------------------------------------------------------------------
+
+
+def initBlankState(qureg: Qureg) -> None:
+    qureg.amps = qureg.device_put(K.init_blank_state(qureg.num_amps_total, qureg.dtype))
+
+
+def initZeroState(qureg: Qureg) -> None:
+    if qureg.is_density_matrix:
+        qureg.amps = qureg.device_put(
+            K.init_classical_density(qureg.num_qubits_represented, 0, qureg.dtype)
+        )
+    else:
+        qureg.amps = qureg.device_put(K.init_zero_state(qureg.num_amps_total, qureg.dtype))
+    qureg.qasm_log.init_zero()
+
+
+def initPlusState(qureg: Qureg) -> None:
+    if qureg.is_density_matrix:
+        qureg.amps = qureg.device_put(
+            D.init_pure_state_density(
+                K.init_plus_state(1 << qureg.num_qubits_represented, qureg.dtype),
+                num_qubits=qureg.num_qubits_represented,
+            )
+        )
+    else:
+        qureg.amps = qureg.device_put(K.init_plus_state(qureg.num_amps_total, qureg.dtype))
+
+
+def initClassicalState(qureg: Qureg, stateInd: int) -> None:
+    if stateInd < 0 or stateInd >= (1 << qureg.num_qubits_represented):
+        raise V.QuESTError("initClassicalState: Invalid state index.")
+    if qureg.is_density_matrix:
+        qureg.amps = qureg.device_put(
+            K.init_classical_density(qureg.num_qubits_represented, stateInd, qureg.dtype)
+        )
+    else:
+        qureg.amps = qureg.device_put(
+            K.init_classical_state(qureg.num_amps_total, stateInd, qureg.dtype)
+        )
+
+
+def initPureState(qureg: Qureg, pure: Qureg) -> None:
+    V.validate_state_vector(pure, "initPureState")
+    V.validate_matching_qureg_dims(qureg, pure, "initPureState")
+    if qureg.is_density_matrix:
+        qureg.amps = qureg.device_put(
+            D.init_pure_state_density(pure.amps, num_qubits=qureg.num_qubits_represented)
+        )
+    else:
+        qureg.amps = jnp.array(pure.amps, copy=True)
+
+
+def initDebugState(qureg: Qureg) -> None:
+    qureg.amps = qureg.device_put(K.init_debug_state(qureg.num_amps_total, qureg.dtype))
+
+
+def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
+    re = np.asarray(reals, dtype=np.float64).ravel()
+    im = np.asarray(imags, dtype=np.float64).ravel()
+    if re.size != qureg.num_amps_total or im.size != qureg.num_amps_total:
+        raise V.QuESTError("initStateFromAmps: Incorrect number of amplitudes.")
+    qureg.amps = qureg.device_put(np.stack([re, im]))
+
+
+def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
+    V.validate_state_vector(qureg, "setAmps")
+    V.validate_num_amps(qureg, startInd, numAmps, "setAmps")
+    vals = np.stack(
+        [
+            np.asarray(reals, dtype=np.float64)[:numAmps],
+            np.asarray(imags, dtype=np.float64)[:numAmps],
+        ]
+    )
+    qureg.amps = qureg.amps.at[:, startInd:startInd + numAmps].set(
+        vals.astype(qureg.dtype)
+    )
+
+
+def setDensityAmps(qureg: Qureg, reals, imags) -> None:
+    """Debug API (QuEST_debug.h): overwrite all rho amplitudes."""
+    V.validate_density_matrix(qureg, "setDensityAmps")
+    re = np.asarray(reals, dtype=np.float64).ravel()
+    im = np.asarray(imags, dtype=np.float64).ravel()
+    qureg.amps = qureg.device_put(np.stack([re, im]))
+
+
+def cloneQureg(targetQureg: Qureg, copyQureg: Qureg) -> None:
+    V.validate_matching_qureg_types(targetQureg, copyQureg, "cloneQureg")
+    V.validate_matching_qureg_dims(targetQureg, copyQureg, "cloneQureg")
+    targetQureg.amps = jnp.array(copyQureg.amps, copy=True)
+
+
+# ---------------------------------------------------------------------------
+# Unitary dispatch helpers (QuEST.c:177-346 twin-op pattern)
+# ---------------------------------------------------------------------------
+
+
+def _sv_n(qureg: Qureg) -> int:
+    return qureg.num_qubits_in_state_vec
+
+
+def _shift(qureg: Qureg) -> int:
+    return qureg.num_qubits_represented
+
+
+def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
+    """Kernel on ket qubits; conjugated twin on bra qubits for rho
+    (QuEST.c:181-183).  ``matrix`` is host complex; stacked to SoA here."""
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
+    control_states = tuple(int(s) for s in control_states)
+    stacked = CX.soa(matrix)
+    qureg.amps = K.apply_matrix(
+        qureg.amps, stacked, num_qubits=_sv_n(qureg), targets=targets,
+        controls=controls, control_states=control_states,
+    )
+    if qureg.is_density_matrix:
+        sh = _shift(qureg)
+        conj_stacked = np.stack([stacked[0], -stacked[1]])
+        qureg.amps = K.apply_matrix(
+            qureg.amps, conj_stacked, num_qubits=_sv_n(qureg),
+            targets=tuple(t + sh for t in targets),
+            controls=tuple(c + sh for c in controls),
+            control_states=control_states,
+        )
+
+
+def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
+    control_states = tuple(int(s) for s in control_states)
+    stacked = CX.soa(diag)
+    qureg.amps = K.apply_diagonal(
+        qureg.amps, stacked, num_qubits=_sv_n(qureg), targets=targets,
+        controls=controls, control_states=control_states,
+    )
+    if qureg.is_density_matrix:
+        sh = _shift(qureg)
+        conj_stacked = np.stack([stacked[0], -stacked[1]])
+        qureg.amps = K.apply_diagonal(
+            qureg.amps, conj_stacked, num_qubits=_sv_n(qureg),
+            targets=tuple(t + sh for t in targets),
+            controls=tuple(c + sh for c in controls),
+            control_states=control_states,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Unitaries (QuEST.h:1595-4744)
+# ---------------------------------------------------------------------------
+
+
+def phaseShift(qureg: Qureg, targetQubit: int, angle: float) -> None:
+    V.validate_target(qureg, targetQubit, "phaseShift")
+    _apply_diag(qureg, G.phase_shift_diag(angle), (targetQubit,))
+    qureg.qasm_log.phase_shift(float(angle), (), targetQubit)
+
+
+def controlledPhaseShift(qureg: Qureg, idQubit1: int, idQubit2: int, angle: float) -> None:
+    V.validate_control_target(qureg, idQubit1, idQubit2, "controlledPhaseShift")
+    _apply_diag(qureg, G.phase_shift_diag(angle), (idQubit2,), (idQubit1,))
+    qureg.qasm_log.phase_shift(float(angle), (idQubit1,), idQubit2)
+
+
+def multiControlledPhaseShift(qureg: Qureg, controlQubits: Sequence[int], angle: float) -> None:
+    """Phase on the all-ones state of the listed qubits.  List lengths
+    replace the C API's explicit count arguments throughout this binding."""
+    qubits = [int(q) for q in controlQubits]
+    V.validate_multi_qubits(qureg, qubits, "multiControlledPhaseShift")
+    _apply_diag(qureg, G.phase_shift_diag(angle), (qubits[-1],), tuple(qubits[:-1]))
+    qureg.qasm_log.phase_shift(float(angle), tuple(qubits[:-1]), qubits[-1])
+
+
+def controlledPhaseFlip(qureg: Qureg, idQubit1: int, idQubit2: int) -> None:
+    V.validate_control_target(qureg, idQubit1, idQubit2, "controlledPhaseFlip")
+    _apply_diag(qureg, G.Z_DIAG, (idQubit2,), (idQubit1,))
+    qureg.qasm_log.gate("z", (idQubit1,), idQubit2)
+
+
+def multiControlledPhaseFlip(qureg: Qureg, controlQubits: Sequence[int]) -> None:
+    qubits = [int(q) for q in controlQubits]
+    V.validate_multi_qubits(qureg, qubits, "multiControlledPhaseFlip")
+    _apply_diag(qureg, G.Z_DIAG, (qubits[-1],), tuple(qubits[:-1]))
+    qureg.qasm_log.gate("z", tuple(qubits[:-1]), qubits[-1])
+
+
+def sGate(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "sGate")
+    _apply_diag(qureg, G.S_GATE_DIAG, (targetQubit,))
+    qureg.qasm_log.gate("s", (), targetQubit)
+
+
+def tGate(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "tGate")
+    _apply_diag(qureg, G.T_GATE_DIAG, (targetQubit,))
+    qureg.qasm_log.gate("t", (), targetQubit)
+
+
+def compactUnitary(qureg: Qureg, targetQubit: int, alpha, beta) -> None:
+    V.validate_target(qureg, targetQubit, "compactUnitary")
+    alpha, beta = complex(alpha), complex(beta)
+    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
+        raise V.QuESTError("compactUnitary: Compact matrix formed by given complex numbers is not unitary.")
+    m = G.compact_unitary_matrix(alpha, beta)
+    _apply_unitary(qureg, m, (targetQubit,))
+    qureg.qasm_log.unitary_2x2(np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]]), (), targetQubit)
+
+
+def unitary(qureg: Qureg, targetQubit: int, u) -> None:
+    V.validate_target(qureg, targetQubit, "unitary")
+    V.validate_unitary(u, 1, "unitary")
+    _apply_unitary(qureg, u, (targetQubit,))
+    qureg.qasm_log.unitary_2x2(np.asarray(u, complex), (), targetQubit)
+
+
+def rotateX(qureg: Qureg, rotQubit: int, angle: float) -> None:
+    V.validate_target(qureg, rotQubit, "rotateX")
+    _apply_unitary(qureg, G.rotate_x_matrix(angle), (rotQubit,))
+    qureg.qasm_log.gate("Rx", (), rotQubit, [float(angle)])
+
+
+def rotateY(qureg: Qureg, rotQubit: int, angle: float) -> None:
+    V.validate_target(qureg, rotQubit, "rotateY")
+    _apply_unitary(qureg, G.rotate_y_matrix(angle), (rotQubit,))
+    qureg.qasm_log.gate("Ry", (), rotQubit, [float(angle)])
+
+
+def rotateZ(qureg: Qureg, rotQubit: int, angle: float) -> None:
+    V.validate_target(qureg, rotQubit, "rotateZ")
+    _apply_diag(qureg, G.rotate_z_diag(angle), (rotQubit,))
+    qureg.qasm_log.gate("Rz", (), rotQubit, [float(angle)])
+
+
+def rotateAroundAxis(qureg: Qureg, rotQubit: int, angle: float, axis) -> None:
+    V.validate_target(qureg, rotQubit, "rotateAroundAxis")
+    ax = _axis_vec(axis)
+    V.validate_unit_vector(ax[0], ax[1], ax[2], "rotateAroundAxis")
+    m = G.rotate_around_axis_matrix(angle, ax)
+    _apply_unitary(qureg, m, (rotQubit,))
+    qureg.qasm_log.unitary_2x2(np.asarray(m), (), rotQubit)
+
+
+def controlledRotateX(qureg, controlQubit, targetQubit, angle) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledRotateX")
+    _apply_unitary(qureg, G.rotate_x_matrix(angle), (targetQubit,), (controlQubit,))
+    qureg.qasm_log.gate("Rx", (controlQubit,), targetQubit, [float(angle)])
+
+
+def controlledRotateY(qureg, controlQubit, targetQubit, angle) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledRotateY")
+    _apply_unitary(qureg, G.rotate_y_matrix(angle), (targetQubit,), (controlQubit,))
+    qureg.qasm_log.gate("Ry", (controlQubit,), targetQubit, [float(angle)])
+
+
+def controlledRotateZ(qureg, controlQubit, targetQubit, angle) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledRotateZ")
+    _apply_diag(
+        qureg,
+        G.rotate_z_diag(angle),
+        (targetQubit,),
+        (controlQubit,),
+    )
+    qureg.qasm_log.gate("Rz", (controlQubit,), targetQubit, [float(angle)])
+
+
+def controlledRotateAroundAxis(qureg, controlQubit, targetQubit, angle, axis) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledRotateAroundAxis")
+    ax = _axis_vec(axis)
+    V.validate_unit_vector(ax[0], ax[1], ax[2], "controlledRotateAroundAxis")
+    m = G.rotate_around_axis_matrix(angle, ax)
+    _apply_unitary(qureg, m, (targetQubit,), (controlQubit,))
+    qureg.qasm_log.unitary_2x2(np.asarray(m), (controlQubit,), targetQubit)
+
+
+def controlledCompactUnitary(qureg, controlQubit, targetQubit, alpha, beta) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledCompactUnitary")
+    alpha, beta = complex(alpha), complex(beta)
+    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
+        raise V.QuESTError("controlledCompactUnitary: Compact matrix formed by given complex numbers is not unitary.")
+    _apply_unitary(qureg, G.compact_unitary_matrix(alpha, beta), (targetQubit,), (controlQubit,))
+    qureg.qasm_log.unitary_2x2(
+        np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]]),
+        (controlQubit,), targetQubit,
+    )
+
+
+def controlledUnitary(qureg, controlQubit, targetQubit, u) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledUnitary")
+    V.validate_unitary(u, 1, "controlledUnitary")
+    _apply_unitary(qureg, u, (targetQubit,), (controlQubit,))
+    qureg.qasm_log.unitary_2x2(np.asarray(u, complex), (controlQubit,), targetQubit)
+
+
+def multiControlledUnitary(qureg, controlQubits, targetQubit, u) -> None:
+    controls, target = [int(c) for c in controlQubits], int(targetQubit)
+    V.validate_multi_controls_targets(qureg, controls, [target], "multiControlledUnitary")
+    V.validate_unitary(u, 1, "multiControlledUnitary")
+    _apply_unitary(qureg, u, (target,), tuple(controls))
+    qureg.qasm_log.unitary_2x2(np.asarray(u, complex), tuple(controls), target)
+
+
+def multiStateControlledUnitary(qureg, controlQubits, controlStates, targetQubit, u) -> None:
+    controls = list(controlQubits)
+    states = list(controlStates)
+    V.validate_multi_controls_targets(qureg, controls, [targetQubit], "multiStateControlledUnitary")
+    V.validate_control_states(controls, states, "multiStateControlledUnitary")
+    V.validate_unitary(u, 1, "multiStateControlledUnitary")
+    _apply_unitary(qureg, u, (targetQubit,), tuple(controls), tuple(states))
+    qureg.qasm_log.unitary_2x2(np.asarray(u, complex), tuple(controls), targetQubit, states)
+
+
+def pauliX(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "pauliX")
+    _apply_not(qureg, (targetQubit,), ())
+    qureg.qasm_log.gate("x", (), targetQubit)
+
+
+def pauliY(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "pauliY")
+    _apply_unitary(qureg, G.PAULI_Y, (targetQubit,))
+    qureg.qasm_log.gate("y", (), targetQubit)
+
+
+def pauliZ(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "pauliZ")
+    _apply_diag(qureg, G.Z_DIAG, (targetQubit,))
+    qureg.qasm_log.gate("z", (), targetQubit)
+
+
+def hadamard(qureg: Qureg, targetQubit: int) -> None:
+    V.validate_target(qureg, targetQubit, "hadamard")
+    _apply_unitary(qureg, G.HADAMARD, (targetQubit,))
+    qureg.qasm_log.gate("h", (), targetQubit)
+
+
+def controlledNot(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledNot")
+    _apply_not(qureg, (targetQubit,), (controlQubit,))
+    qureg.qasm_log.gate("x", (controlQubit,), targetQubit)
+
+
+def multiQubitNot(qureg: Qureg, targs: Sequence[int]) -> None:
+    targets = [int(t) for t in targs]
+    V.validate_multi_qubits(qureg, targets, "multiQubitNot")
+    _apply_not(qureg, tuple(targets), ())
+    for t in targets:
+        qureg.qasm_log.gate("x", (), t)
+
+
+def multiControlledMultiQubitNot(qureg, ctrls, targs) -> None:
+    controls, targets = [int(c) for c in ctrls], [int(t) for t in targs]
+    V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiQubitNot")
+    _apply_not(qureg, tuple(targets), tuple(controls))
+    for t in targets:
+        qureg.qasm_log.gate("x", tuple(controls), t)
+
+
+def _apply_not(qureg, targets, controls, control_states=()):
+    qureg.amps = K.apply_multi_qubit_not(
+        qureg.amps, num_qubits=_sv_n(qureg), targets=targets,
+        controls=controls, control_states=control_states,
+    )
+    if qureg.is_density_matrix:
+        sh = _shift(qureg)
+        qureg.amps = K.apply_multi_qubit_not(
+            qureg.amps, num_qubits=_sv_n(qureg),
+            targets=tuple(t + sh for t in targets),
+            controls=tuple(c + sh for c in controls),
+            control_states=control_states,
+        )
+
+
+def controlledPauliY(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
+    V.validate_control_target(qureg, controlQubit, targetQubit, "controlledPauliY")
+    _apply_unitary(qureg, G.PAULI_Y, (targetQubit,), (controlQubit,))
+    qureg.qasm_log.gate("y", (controlQubit,), targetQubit)
+
+
+def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
+    V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
+    qureg.amps = K.swap_qubit_amps(qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
+    if qureg.is_density_matrix:
+        sh = _shift(qureg)
+        qureg.amps = K.swap_qubit_amps(
+            qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1 + sh, qb2=qubit2 + sh
+        )
+    qureg.qasm_log.gate("swap", (qubit1,), qubit2)
+
+
+def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
+    V.validate_unique_targets(qureg, qb1, qb2, "sqrtSwapGate")
+    _apply_unitary(qureg, G.SQRT_SWAP, (qb1, qb2))
+    qureg.qasm_log.gate("sqrtswap", (qb1,), qb2)
+
+
+def multiRotateZ(qureg: Qureg, qubits: Sequence[int], angle: float) -> None:
+    qubits, angle = [int(q) for q in qubits], float(angle)
+    V.validate_multi_qubits(qureg, qubits, "multiRotateZ")
+    _apply_parity_phase(qureg, angle, tuple(qubits), ())
+    qureg.qasm_log.comment(f"multiRotateZ(angle={angle:g}) on qubits {qubits}")
+
+
+def multiControlledMultiRotateZ(qureg, controlQubits, targetQubits, angle) -> None:
+    controls, targets = list(controlQubits), list(targetQubits)
+    V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiRotateZ")
+    _apply_parity_phase(qureg, angle, tuple(targets), tuple(controls))
+    qureg.qasm_log.comment(
+        f"multiControlledMultiRotateZ(angle={angle:g}) ctrls {controls} targs {targets}"
+    )
+
+
+def _apply_parity_phase(qureg, angle, qubits, controls, conj=False):
+    a = -angle if conj else angle
+    qureg.amps = K.apply_parity_phase(
+        qureg.amps, a, num_qubits=_sv_n(qureg), qubits=qubits, controls=controls
+    )
+    if qureg.is_density_matrix:
+        sh = _shift(qureg)
+        qureg.amps = K.apply_parity_phase(
+            qureg.amps, -a, num_qubits=_sv_n(qureg),
+            qubits=tuple(q + sh for q in qubits),
+            controls=tuple(c + sh for c in controls),
+        )
+
+
+def multiRotatePauli(qureg: Qureg, targetQubits, targetPaulis, angle: float) -> None:
+    targets = [int(t) for t in targetQubits]
+    paulis = [int(p) for p in targetPaulis]
+    V.validate_multi_qubits(qureg, targets, "multiRotatePauli")
+    V.validate_pauli_codes(paulis, "multiRotatePauli")
+    _multi_rotate_pauli(qureg, targets, paulis, float(angle), controls=())
+    qureg.qasm_log.comment(
+        f"multiRotatePauli(angle={angle:g}) on qubits {targets} paulis {paulis}"
+    )
+
+
+def multiControlledMultiRotatePauli(qureg, controlQubits, targetQubits, targetPaulis, angle) -> None:
+    controls = [int(c) for c in controlQubits]
+    targets = [int(t) for t in targetQubits]
+    paulis = [int(p) for p in targetPaulis]
+    V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiRotatePauli")
+    V.validate_pauli_codes(paulis, "multiControlledMultiRotatePauli")
+    _multi_rotate_pauli(qureg, targets, paulis, float(angle), controls=tuple(controls))
+    qureg.qasm_log.comment(
+        f"multiControlledMultiRotatePauli(angle={angle:g}) ctrls {controls} targs {targets} paulis {paulis}"
+    )
+
+
+_RY_M90 = (1 / math.sqrt(2)) * np.array([[1, 1], [-1, 1]], dtype=complex)  # Z->X
+_RX_P90 = (1 / math.sqrt(2)) * np.array([[1, -1j], [-1j, 1]], dtype=complex)  # Z->Y
+
+
+def _multi_rotate_pauli(qureg, targets, paulis, angle, controls):
+    """Basis-rotate X/Y targets onto Z, multiRotateZ, unrotate
+    (statevec_multiRotatePauli, QuEST_common.c:424-462).  The basis gates are
+    applied through the twin-aware helpers so the rho path is automatic."""
+    z_qubits = []
+    for t, p in zip(targets, paulis):
+        if p == PAULI_I:
+            continue
+        z_qubits.append(t)
+        if p == PAULI_X:
+            _apply_unitary(qureg, _RY_M90, (t,), controls)
+        elif p == PAULI_Y:
+            _apply_unitary(qureg, _RX_P90, (t,), controls)
+    if z_qubits:
+        _apply_parity_phase(qureg, angle, tuple(z_qubits), controls)
+    for t, p in zip(targets, paulis):
+        if p == PAULI_X:
+            _apply_unitary(qureg, _RY_M90.conj().T, (t,), controls)
+        elif p == PAULI_Y:
+            _apply_unitary(qureg, _RX_P90.conj().T, (t,), controls)
+
+
+def twoQubitUnitary(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> None:
+    V.validate_unique_targets(qureg, targetQubit1, targetQubit2, "twoQubitUnitary")
+    V.validate_unitary(u, 2, "twoQubitUnitary")
+    _apply_unitary(qureg, u, (targetQubit1, targetQubit2))
+    qureg.qasm_log.comment("twoQubitUnitary applied")
+
+
+def controlledTwoQubitUnitary(qureg, controlQubit, targetQubit1, targetQubit2, u) -> None:
+    V.validate_multi_controls_targets(
+        qureg, [controlQubit], [targetQubit1, targetQubit2], "controlledTwoQubitUnitary"
+    )
+    V.validate_unitary(u, 2, "controlledTwoQubitUnitary")
+    _apply_unitary(qureg, u, (targetQubit1, targetQubit2), (controlQubit,))
+    qureg.qasm_log.comment("controlledTwoQubitUnitary applied")
+
+
+def multiControlledTwoQubitUnitary(qureg, controlQubits, targetQubit1, targetQubit2, u) -> None:
+    controls = list(controlQubits)
+    V.validate_multi_controls_targets(
+        qureg, controls, [targetQubit1, targetQubit2], "multiControlledTwoQubitUnitary"
+    )
+    V.validate_unitary(u, 2, "multiControlledTwoQubitUnitary")
+    _apply_unitary(qureg, u, (targetQubit1, targetQubit2), tuple(controls))
+    qureg.qasm_log.comment("multiControlledTwoQubitUnitary applied")
+
+
+def multiQubitUnitary(qureg: Qureg, targs: Sequence[int], u) -> None:
+    targets = list(targs)
+    V.validate_multi_qubits(qureg, targets, "multiQubitUnitary")
+    V.validate_unitary(u, len(targets), "multiQubitUnitary")
+    _apply_unitary(qureg, u, tuple(targets))
+    qureg.qasm_log.comment("multiQubitUnitary applied")
+
+
+def controlledMultiQubitUnitary(qureg, ctrl, targs, u) -> None:
+    targets = list(targs)
+    V.validate_multi_controls_targets(qureg, [ctrl], targets, "controlledMultiQubitUnitary")
+    V.validate_unitary(u, len(targets), "controlledMultiQubitUnitary")
+    _apply_unitary(qureg, u, tuple(targets), (ctrl,))
+    qureg.qasm_log.comment("controlledMultiQubitUnitary applied")
+
+
+def multiControlledMultiQubitUnitary(qureg, ctrls, targs, u) -> None:
+    controls, targets = list(ctrls), list(targs)
+    V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiQubitUnitary")
+    V.validate_unitary(u, len(targets), "multiControlledMultiQubitUnitary")
+    _apply_unitary(qureg, u, tuple(targets), tuple(controls))
+    qureg.qasm_log.comment("multiControlledMultiQubitUnitary applied")
+
+
+def _axis_vec(axis):
+    if hasattr(axis, "x"):
+        return (float(axis.x), float(axis.y), float(axis.z))
+    ax = np.asarray(axis, dtype=np.float64)
+    return (float(ax[0]), float(ax[1]), float(ax[2]))
+
+
+class Vector:
+    """3-vector for rotateAroundAxis (QuEST.h:198)."""
+
+    def __init__(self, x: float, y: float, z: float):
+        self.x, self.y, self.z = float(x), float(y), float(z)

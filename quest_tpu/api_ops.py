@@ -1,0 +1,691 @@
+"""Public API, part 2: measurements, decoherence channels, calculations,
+composite operators (apply*), and QASM recording control.
+
+Continues quest_tpu.api (same dispatch conventions; see that module's
+docstring).  Reference parity: QuEST.c:985-1602 + QuEST_common.c composites.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import validation as V
+from .ops import calculations as C
+from .ops import density as D
+from .ops import kernels as K
+from .ops import paulis as P
+from .ops import phasefunc as PF
+from .precision import real_eps
+from .qureg import DiagonalOp, PauliHamil, Qureg
+from .rng import GLOBAL_RNG
+from .api import (
+    PAULI_I,
+    _apply_diag,
+    _apply_unitary,
+    _shift,
+    _sv_n,
+    hadamard,
+    swapGate,
+)
+
+# ---------------------------------------------------------------------------
+# Measurement (QuEST.c:985-995, QuEST_common.c:168-183,374-380)
+# ---------------------------------------------------------------------------
+
+
+def calcProbOfOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
+    V.validate_target(qureg, measureQubit, "calcProbOfOutcome")
+    V.validate_outcome(outcome, "calcProbOfOutcome")
+    if qureg.is_density_matrix:
+        p = C.calc_prob_of_outcome_density(
+            qureg.amps, num_qubits=qureg.num_qubits_represented,
+            target=measureQubit, outcome=outcome,
+        )
+    else:
+        p = C.calc_prob_of_outcome_statevec(
+            qureg.amps, num_qubits=_sv_n(qureg), target=measureQubit, outcome=outcome
+        )
+    return float(p)
+
+
+def calcProbOfAllOutcomes(qureg: Qureg, qubits: Sequence[int]) -> np.ndarray:
+    qubits = [int(q) for q in qubits]
+    V.validate_multi_qubits(qureg, qubits, "calcProbOfAllOutcomes")
+    if qureg.is_density_matrix:
+        p = C.calc_prob_of_all_outcomes_density(
+            qureg.amps, num_qubits=qureg.num_qubits_represented, qubits=tuple(qubits)
+        )
+    else:
+        p = C.calc_prob_of_all_outcomes_statevec(
+            qureg.amps, num_qubits=_sv_n(qureg), qubits=tuple(qubits)
+        )
+    return np.asarray(p)
+
+
+def _generate_measurement_outcome(zero_prob: float):
+    """(generateMeasurementOutcome, QuEST_common.c:168-183): degenerate
+    probabilities short-circuit; otherwise draw from the global MT RNG."""
+    if zero_prob < real_eps():
+        return 1
+    if 1 - zero_prob < real_eps():
+        return 0
+    return 0 if GLOBAL_RNG.uniform() <= zero_prob else 1
+
+
+def _collapse(qureg: Qureg, qubit: int, outcome: int, prob: float) -> None:
+    if qureg.is_density_matrix:
+        qureg.amps = K.collapse_density(
+            qureg.amps, float(prob), num_qubits=qureg.num_qubits_represented,
+            target=qubit, outcome=outcome,
+        )
+    else:
+        qureg.amps = K.collapse_statevec(
+            qureg.amps, float(prob), num_qubits=_sv_n(qureg),
+            target=qubit, outcome=outcome,
+        )
+
+
+def collapseToOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
+    V.validate_target(qureg, measureQubit, "collapseToOutcome")
+    V.validate_outcome(outcome, "collapseToOutcome")
+    prob = calcProbOfOutcome(qureg, measureQubit, outcome)
+    if prob < real_eps():
+        raise V.QuESTError(
+            "collapseToOutcome: Can't collapse to state with zero probability."
+        )
+    _collapse(qureg, measureQubit, outcome, prob)
+    qureg.qasm_log.comment(f"collapseToOutcome({outcome}) on qubit {measureQubit}")
+    return prob
+
+
+def measure(qureg: Qureg, measureQubit: int) -> int:
+    outcome, _ = measureWithStats(qureg, measureQubit)
+    return outcome
+
+
+def measureWithStats(qureg: Qureg, measureQubit: int):
+    V.validate_target(qureg, measureQubit, "measureWithStats")
+    zero_prob = calcProbOfOutcome(qureg, measureQubit, 0)
+    outcome = _generate_measurement_outcome(zero_prob)
+    prob = zero_prob if outcome == 0 else 1 - zero_prob
+    _collapse(qureg, measureQubit, outcome, prob)
+    qureg.qasm_log.measure(measureQubit)
+    return outcome, prob
+
+
+# ---------------------------------------------------------------------------
+# Decoherence (QuEST.c:1259-1331; channels in ops.density)
+# ---------------------------------------------------------------------------
+
+
+def mixDephasing(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    V.validate_density_matrix(qureg, "mixDephasing")
+    V.validate_target(qureg, targetQubit, "mixDephasing")
+    V.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability")
+    qureg.amps = D.mix_dephasing(
+        qureg.amps, prob, num_qubits=qureg.num_qubits_represented, target=targetQubit
+    )
+
+
+def mixTwoQubitDephasing(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
+    V.validate_density_matrix(qureg, "mixTwoQubitDephasing")
+    V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDephasing")
+    V.validate_prob(prob, "mixTwoQubitDephasing", 0.75, "two-qubit dephasing probability")
+    qureg.amps = D.mix_two_qubit_dephasing(
+        qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
+        qubit1=qubit1, qubit2=qubit2,
+    )
+
+
+def _mix_kraus(qureg: Qureg, ops, targets) -> None:
+    qureg.amps = D.apply_kraus_map(
+        qureg.amps, ops, num_qubits=qureg.num_qubits_represented, targets=tuple(targets)
+    )
+
+
+def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    V.validate_density_matrix(qureg, "mixDepolarising")
+    V.validate_target(qureg, targetQubit, "mixDepolarising")
+    V.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability")
+    _mix_kraus(qureg, D.depolarising_kraus(prob, qureg.dtype), (targetQubit,))
+
+
+def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    V.validate_density_matrix(qureg, "mixDamping")
+    V.validate_target(qureg, targetQubit, "mixDamping")
+    V.validate_prob(prob, "mixDamping", 1.0, "damping probability")
+    _mix_kraus(qureg, D.damping_kraus(prob, qureg.dtype), (targetQubit,))
+
+
+def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
+    V.validate_density_matrix(qureg, "mixTwoQubitDepolarising")
+    V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDepolarising")
+    V.validate_prob(prob, "mixTwoQubitDepolarising", 15.0 / 16, "two-qubit depolarising probability")
+    _mix_kraus(
+        qureg, D.two_qubit_depolarising_kraus(prob, qureg.dtype), (qubit1, qubit2)
+    )
+
+
+def mixPauli(qureg: Qureg, targetQubit: int, probX: float, probY: float, probZ: float) -> None:
+    V.validate_density_matrix(qureg, "mixPauli")
+    V.validate_target(qureg, targetQubit, "mixPauli")
+    for p, nm in ((probX, "X"), (probY, "Y"), (probZ, "Z")):
+        V.validate_prob(p, "mixPauli", 1.0, f"Pauli-{nm} probability")
+    if probX + probY + probZ > 1 + real_eps():
+        raise V.QuESTError("mixPauli: The probabilities must sum to <= 1.")
+    _mix_kraus(qureg, D.pauli_kraus(probX, probY, probZ, qureg.dtype), (targetQubit,))
+
+
+def mixDensityMatrix(combineQureg: Qureg, prob: float, otherQureg: Qureg) -> None:
+    V.validate_density_matrix(combineQureg, "mixDensityMatrix")
+    V.validate_density_matrix(otherQureg, "mixDensityMatrix")
+    V.validate_matching_qureg_dims(combineQureg, otherQureg, "mixDensityMatrix")
+    V.validate_prob(prob, "mixDensityMatrix")
+    combineQureg.amps = D.mix_density_matrix(combineQureg.amps, otherQureg.amps, prob)
+
+
+def mixKrausMap(qureg: Qureg, target: int, ops, numOps: Optional[int] = None) -> None:
+    ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
+    V.validate_density_matrix(qureg, "mixKrausMap")
+    V.validate_target(qureg, target, "mixKrausMap")
+    V.validate_kraus_ops(ops, 1, "mixKrausMap")
+    _mix_kraus(qureg, [np.asarray(o, complex) for o in ops], (target,))
+
+
+def mixTwoQubitKrausMap(qureg: Qureg, target1: int, target2: int, ops, numOps: Optional[int] = None) -> None:
+    ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
+    V.validate_density_matrix(qureg, "mixTwoQubitKrausMap")
+    V.validate_unique_targets(qureg, target1, target2, "mixTwoQubitKrausMap")
+    V.validate_kraus_ops(ops, 2, "mixTwoQubitKrausMap")
+    _mix_kraus(qureg, [np.asarray(o, complex) for o in ops], (target1, target2))
+
+
+def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops, numOps: Optional[int] = None) -> None:
+    ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
+    targets = [int(t) for t in targets]
+    V.validate_density_matrix(qureg, "mixMultiQubitKrausMap")
+    V.validate_multi_qubits(qureg, targets, "mixMultiQubitKrausMap")
+    V.validate_kraus_ops(ops, len(targets), "mixMultiQubitKrausMap")
+    _mix_kraus(qureg, [np.asarray(o, complex) for o in ops], tuple(targets))
+
+
+# ---------------------------------------------------------------------------
+# Calculations (QuEST.h:1987-2099, 3246-3724, 4189-4285, 4911)
+# ---------------------------------------------------------------------------
+
+
+def getAmp(qureg: Qureg, index: int) -> complex:
+    V.validate_state_vector(qureg, "getAmp")
+    V.validate_num_amps(qureg, index, 1, "getAmp")
+    pair = np.asarray(qureg.amps[:, index])
+    return complex(pair[0], pair[1])
+
+
+def getRealAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).real
+
+
+def getImagAmp(qureg: Qureg, index: int) -> float:
+    return getAmp(qureg, index).imag
+
+
+def getProbAmp(qureg: Qureg, index: int) -> float:
+    a = getAmp(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
+    V.validate_density_matrix(qureg, "getDensityAmp")
+    dim = 1 << qureg.num_qubits_represented
+    if not (0 <= row < dim and 0 <= col < dim):
+        raise V.QuESTError("getDensityAmp: Invalid amplitude index.")
+    pair = np.asarray(qureg.amps[:, row + col * dim])
+    return complex(pair[0], pair[1])
+
+
+def calcTotalProb(qureg: Qureg) -> float:
+    if qureg.is_density_matrix:
+        return float(
+            C.calc_total_prob_density(qureg.amps, num_qubits=qureg.num_qubits_represented)
+        )
+    return float(C.calc_total_prob_statevec(qureg.amps))
+
+
+def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
+    V.validate_state_vector(bra, "calcInnerProduct")
+    V.validate_state_vector(ket, "calcInnerProduct")
+    V.validate_matching_qureg_dims(bra, ket, "calcInnerProduct")
+    r = np.asarray(C.calc_inner_product(bra.amps, ket.amps))
+    return complex(r[0], r[1])
+
+
+def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
+    V.validate_density_matrix(rho1, "calcDensityInnerProduct")
+    V.validate_density_matrix(rho2, "calcDensityInnerProduct")
+    V.validate_matching_qureg_dims(rho1, rho2, "calcDensityInnerProduct")
+    return float(C.calc_density_inner_product(rho1.amps, rho2.amps))
+
+
+def calcPurity(qureg: Qureg) -> float:
+    V.validate_density_matrix(qureg, "calcPurity")
+    return float(C.calc_purity(qureg.amps))
+
+
+def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
+    V.validate_state_vector(pureState, "calcFidelity")
+    V.validate_matching_qureg_dims(qureg, pureState, "calcFidelity")
+    if qureg.is_density_matrix:
+        return float(
+            C.calc_fidelity_density(
+                qureg.amps, pureState.amps, num_qubits=qureg.num_qubits_represented
+            )
+        )
+    ip = C.calc_inner_product(qureg.amps, pureState.amps)
+    return abs(ip) ** 2
+
+
+def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
+    V.validate_density_matrix(a, "calcHilbertSchmidtDistance")
+    V.validate_density_matrix(b, "calcHilbertSchmidtDistance")
+    V.validate_matching_qureg_dims(a, b, "calcHilbertSchmidtDistance")
+    return float(C.calc_hilbert_schmidt_distance(a.amps, b.amps))
+
+
+def _full_codes(qureg, targets, codes) -> tuple:
+    n = qureg.num_qubits_represented
+    full = [PAULI_I] * n
+    for t, c in zip(targets, codes):
+        full[t] = int(c)
+    return tuple(full)
+
+
+def calcExpecPauliProd(qureg: Qureg, targetQubits, pauliCodes, workspace: Optional[Qureg] = None) -> float:
+    targets = [int(t) for t in targetQubits]
+    codes = [int(c) for c in pauliCodes]
+    V.validate_multi_qubits(qureg, targets, "calcExpecPauliProd")
+    V.validate_pauli_codes(codes, "calcExpecPauliProd")
+    coeffs = np.ones(1)
+    flat = _full_codes(qureg, targets, codes)
+    if qureg.is_density_matrix:
+        val = P.calc_expec_pauli_sum_density(
+            qureg.amps, coeffs, num_qubits=qureg.num_qubits_represented,
+            codes_flat=flat, num_terms=1,
+        )
+    else:
+        val = P.calc_expec_pauli_sum_statevec(
+            qureg.amps, coeffs, num_qubits=qureg.num_qubits_represented,
+            codes_flat=flat, num_terms=1,
+        )
+    return float(val)
+
+
+def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Optional[Qureg] = None) -> float:
+    n = qureg.num_qubits_represented
+    codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
+    coeffs = np.asarray(termCoeffs, dtype=np.float64)
+    num_terms = coeffs.size
+    if len(codes) != num_terms * n:
+        raise V.QuESTError("calcExpecPauliSum: Number of Pauli codes doesn't match numSumTerms*numQubits.")
+    V.validate_pauli_codes(codes, "calcExpecPauliSum")
+    cj = coeffs
+    if qureg.is_density_matrix:
+        val = P.calc_expec_pauli_sum_density(
+            qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
+        )
+    else:
+        val = P.calc_expec_pauli_sum_statevec(
+            qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
+        )
+    return float(val)
+
+
+def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Optional[Qureg] = None) -> float:
+    V.validate_pauli_hamil(hamil, "calcExpecPauliHamil")
+    V.validate_hamil_matches_qureg(hamil, qureg, "calcExpecPauliHamil")
+    return calcExpecPauliSum(qureg, hamil.pauli_codes, hamil.term_coeffs, workspace)
+
+
+def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
+    V.validate_diag_op_matches_qureg(op, qureg, "calcExpecDiagonalOp")
+    if qureg.is_density_matrix:
+        r = np.asarray(
+            C.calc_expec_diagonal_density(
+                qureg.amps, op.real, op.imag, num_qubits=qureg.num_qubits_represented
+            )
+        )
+    else:
+        r = np.asarray(C.calc_expec_diagonal_statevec(qureg.amps, op.real, op.imag))
+    return complex(r[0], r[1])
+
+
+# ---------------------------------------------------------------------------
+# Composite operators — apply* family: NO twin, NO unitarity checks
+# (QuEST.c:1074-1105)
+# ---------------------------------------------------------------------------
+
+
+def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg, facOut, out: Qureg) -> None:
+    V.validate_matching_qureg_types(qureg1, qureg2, "setWeightedQureg")
+    V.validate_matching_qureg_types(qureg1, out, "setWeightedQureg")
+    V.validate_matching_qureg_dims(qureg1, qureg2, "setWeightedQureg")
+    V.validate_matching_qureg_dims(qureg1, out, "setWeightedQureg")
+    facs = np.array(
+        [
+            [complex(facOut).real, complex(fac1).real, complex(fac2).real],
+            [complex(facOut).imag, complex(fac1).imag, complex(fac2).imag],
+        ]
+    )
+    out.amps = K.set_weighted_qureg(out.amps, qureg1.amps, qureg2.amps, facs)
+
+
+def _apply_matrix_raw(qureg: Qureg, m, targets, controls=()):
+    from .ops import cplx as CX
+
+    qureg.amps = K.apply_matrix(
+        qureg.amps, CX.soa(m), num_qubits=_sv_n(qureg),
+        targets=tuple(int(t) for t in targets), controls=tuple(int(c) for c in controls),
+    )
+    qureg.qasm_log.comment("here a numeric matrix was applied (not recordable in QASM)")
+
+
+def applyMatrix2(qureg: Qureg, targetQubit: int, u) -> None:
+    V.validate_target(qureg, targetQubit, "applyMatrix2")
+    V.validate_matrix_size(u, 1, "applyMatrix2")
+    _apply_matrix_raw(qureg, u, (targetQubit,))
+
+
+def applyMatrix4(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> None:
+    V.validate_unique_targets(qureg, targetQubit1, targetQubit2, "applyMatrix4")
+    V.validate_matrix_size(u, 2, "applyMatrix4")
+    _apply_matrix_raw(qureg, u, (targetQubit1, targetQubit2))
+
+
+def applyMatrixN(qureg: Qureg, targs: Sequence[int], u) -> None:
+    targets = [int(t) for t in targs]
+    V.validate_multi_qubits(qureg, targets, "applyMatrixN")
+    V.validate_matrix_size(u, len(targets), "applyMatrixN")
+    _apply_matrix_raw(qureg, u, tuple(targets))
+
+
+def applyMultiControlledMatrixN(qureg: Qureg, ctrls: Sequence[int], targs: Sequence[int], u) -> None:
+    controls = [int(c) for c in ctrls]
+    targets = [int(t) for t in targs]
+    V.validate_multi_controls_targets(qureg, controls, targets, "applyMultiControlledMatrixN")
+    V.validate_matrix_size(u, len(targets), "applyMultiControlledMatrixN")
+    _apply_matrix_raw(qureg, u, tuple(targets), tuple(controls))
+
+
+def applyPauliSum(inQureg: Qureg, allPauliCodes, termCoeffs, outQureg: Qureg) -> None:
+    n = inQureg.num_qubits_represented
+    codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
+    coeffs = np.asarray(termCoeffs, dtype=np.float64)
+    num_terms = coeffs.size
+    if len(codes) != num_terms * n:
+        raise V.QuESTError("applyPauliSum: Number of Pauli codes doesn't match numSumTerms*numQubits.")
+    V.validate_pauli_codes(codes, "applyPauliSum")
+    V.validate_matching_qureg_types(inQureg, outQureg, "applyPauliSum")
+    V.validate_matching_qureg_dims(inQureg, outQureg, "applyPauliSum")
+    outQureg.amps = P.apply_pauli_sum(
+        inQureg.amps, coeffs, outQureg.amps,
+        num_qubits=n, num_state_qubits=_sv_n(inQureg),
+        codes_flat=codes, num_terms=num_terms,
+    )
+
+
+def applyPauliHamil(inQureg: Qureg, hamil: PauliHamil, outQureg: Qureg) -> None:
+    V.validate_pauli_hamil(hamil, "applyPauliHamil")
+    V.validate_hamil_matches_qureg(hamil, inQureg, "applyPauliHamil")
+    applyPauliSum(inQureg, hamil.pauli_codes, hamil.term_coeffs, outQureg)
+
+
+def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float, order: int, reps: int) -> None:
+    """Symmetrized Suzuki-Trotter e^{-iHt} (agnostic_applyTrotterCircuit,
+    QuEST_common.c:752-834)."""
+    V.validate_pauli_hamil(hamil, "applyTrotterCircuit")
+    V.validate_hamil_matches_qureg(hamil, qureg, "applyTrotterCircuit")
+    V.validate_trotter_params(order, reps, "applyTrotterCircuit")
+    if time == 0:
+        return
+    for _ in range(reps):
+        _symmetrized_trotter(qureg, hamil, time / reps, order)
+
+
+def _exponentiated_pauli_hamil(qureg, hamil, fac, reverse):
+    from .api import multiRotatePauli
+
+    order = range(hamil.num_sum_terms)
+    if reverse:
+        order = reversed(order)
+    targets = list(range(hamil.num_qubits))
+    for t in order:
+        angle = 2 * fac * float(hamil.term_coeffs[t])
+        multiRotatePauli(qureg, targets, [int(c) for c in hamil.pauli_codes[t]], angle)
+
+
+def _symmetrized_trotter(qureg, hamil, time, order):
+    if order == 1:
+        _exponentiated_pauli_hamil(qureg, hamil, time, False)
+    elif order == 2:
+        _exponentiated_pauli_hamil(qureg, hamil, time / 2, False)
+        _exponentiated_pauli_hamil(qureg, hamil, time / 2, True)
+    else:
+        p = 1.0 / (4 - 4 ** (1.0 / (order - 1)))
+        lower = order - 2
+        _symmetrized_trotter(qureg, hamil, p * time, lower)
+        _symmetrized_trotter(qureg, hamil, p * time, lower)
+        _symmetrized_trotter(qureg, hamil, (1 - 4 * p) * time, lower)
+        _symmetrized_trotter(qureg, hamil, p * time, lower)
+        _symmetrized_trotter(qureg, hamil, p * time, lower)
+
+
+def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
+    """Left-multiplies D onto the state — on rho this is D.rho, NOT D rho D^dag
+    (QuEST.c apply-family semantics; densmatr path QuEST_cpu.c:4042-4082)."""
+    V.validate_diag_op_matches_qureg(op, qureg, "applyDiagonalOp")
+    if qureg.is_density_matrix:
+        qureg.amps = D.apply_diagonal_op_density(
+            qureg.amps, op.real, op.imag, num_qubits=qureg.num_qubits_represented
+        )
+    else:
+        qureg.amps = K.apply_full_diagonal(qureg.amps, op.real, op.imag)
+    qureg.qasm_log.comment("here a diagonal operator was applied")
+
+
+# ---------------------------------------------------------------------------
+# Phase functions (QuEST.h:5571-6326)
+# ---------------------------------------------------------------------------
+
+
+def _empty_overrides():
+    return np.zeros((0, 1), np.int64), np.zeros((0,), np.float64)
+
+
+def _norm_overrides(overrideInds, overridePhases, num_regs):
+    if overrideInds is None or len(np.asarray(overridePhases).ravel()) == 0:
+        return np.zeros((0, num_regs), np.int64), np.zeros((0,), np.float64)
+    inds = np.asarray(overrideInds, np.int64).reshape(-1, num_regs)
+    phases = np.asarray(overridePhases, np.float64).ravel()
+    return inds, phases
+
+
+def _pad_params(params, func_name, num_regs):
+    """Named-func divergence/shift params live at fixed slots
+    (QuEST_cpu.c:4484-4543); pad so the kernel can index them statically."""
+    p = np.asarray(params, np.float64).ravel() if params is not None else np.zeros(0)
+    need = 2 + num_regs  # covers the largest (shifted-norm) layout
+    if p.size < need:
+        p = np.concatenate([p, np.zeros(need - p.size)])
+    return p
+
+
+def applyPhaseFunc(qureg: Qureg, qubits, encoding, coeffs, exponents) -> None:
+    applyPhaseFuncOverrides(qureg, qubits, encoding, coeffs, exponents, None, None)
+
+
+def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents, overrideInds, overridePhases) -> None:
+    qubits = [int(q) for q in qubits]
+    V.validate_multi_qubits(qureg, qubits, "applyPhaseFunc")
+    V.validate_bit_encoding(int(encoding), "applyPhaseFunc")
+    inds, phases = _norm_overrides(overrideInds, overridePhases, 1)
+    V.validate_phase_func_overrides([len(qubits)], int(encoding), inds, "applyPhaseFunc")
+    qureg.amps = PF.apply_phase_func(
+        qureg.amps, np.asarray(coeffs, np.float64), np.asarray(exponents, np.float64),
+        inds, phases,
+        num_qubits=_sv_n(qureg), qubits=tuple(qubits), encoding=int(encoding),
+    )
+    qureg.qasm_log.comment("here a phase function was applied")
+
+
+def applyMultiVarPhaseFunc(qureg: Qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg) -> None:
+    applyMultiVarPhaseFuncOverrides(
+        qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg, None, None
+    )
+
+
+def _split_regs(qubits, numQubitsPerReg):
+    regs = []
+    flat = [int(q) for q in np.asarray(qubits).ravel()]
+    pos = 0
+    for nq in numQubitsPerReg:
+        regs.append(tuple(flat[pos:pos + int(nq)]))
+        pos += int(nq)
+    return tuple(regs)
+
+
+def applyMultiVarPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg, overrideInds, overridePhases) -> None:
+    regs = _split_regs(qubits, numQubitsPerReg)
+    for r in regs:
+        V.validate_multi_qubits(qureg, list(r), "applyMultiVarPhaseFunc")
+    V.validate_bit_encoding(int(encoding), "applyMultiVarPhaseFunc")
+    inds, phases = _norm_overrides(overrideInds, overridePhases, len(regs))
+    V.validate_phase_func_overrides(
+        [len(r) for r in regs], int(encoding), inds, "applyMultiVarPhaseFunc"
+    )
+    qureg.amps = PF.apply_multi_var_phase_func(
+        qureg.amps, np.asarray(coeffs, np.float64), np.asarray(exponents, np.float64),
+        inds, phases,
+        num_qubits=_sv_n(qureg), reg_qubits=regs, encoding=int(encoding),
+        terms_per_reg=tuple(int(t) for t in numTermsPerReg),
+    )
+    qureg.qasm_log.comment("here a multi-variable phase function was applied")
+
+
+def applyNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionNameCode) -> None:
+    applyParamNamedPhaseFuncOverrides(
+        qureg, qubits, numQubitsPerReg, encoding, functionNameCode, None, None, None
+    )
+
+
+def applyNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, overrideInds, overridePhases) -> None:
+    applyParamNamedPhaseFuncOverrides(
+        qureg, qubits, numQubitsPerReg, encoding, functionNameCode, None,
+        overrideInds, overridePhases,
+    )
+
+
+def applyParamNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params) -> None:
+    applyParamNamedPhaseFuncOverrides(
+        qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params, None, None
+    )
+
+
+def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params, overrideInds, overridePhases, *, _conj=False) -> None:
+    regs = _split_regs(qubits, numQubitsPerReg)
+    for r in regs:
+        V.validate_multi_qubits(
+            qureg, [q - (_shift(qureg) if _conj else 0) for q in r], "applyNamedPhaseFunc"
+        )
+    V.validate_bit_encoding(int(encoding), "applyNamedPhaseFunc")
+    V.validate_phase_func_name(int(functionNameCode), "applyNamedPhaseFunc")
+    if int(functionNameCode) in PF._DIST_FUNCS and len(regs) % 2 != 0:
+        raise V.QuESTError(
+            "applyNamedPhaseFunc: Distance phase functions require a even number of sub-registers."
+        )
+    inds, phases = _norm_overrides(overrideInds, overridePhases, len(regs))
+    V.validate_phase_func_overrides(
+        [len(r) for r in regs], int(encoding), inds, "applyNamedPhaseFunc"
+    )
+    qureg.amps = PF.apply_named_phase_func(
+        qureg.amps, _pad_params(params, int(functionNameCode), len(regs)),
+        inds, phases,
+        num_qubits=_sv_n(qureg), reg_qubits=regs, encoding=int(encoding),
+        func_name=int(functionNameCode), conj=_conj,
+    )
+    qureg.qasm_log.comment("here a named phase function was applied")
+
+
+# ---------------------------------------------------------------------------
+# QFT (agnostic_applyQFT, QuEST_common.c:836-898)
+# ---------------------------------------------------------------------------
+
+
+def applyQFT(qureg: Qureg, qubits: Sequence[int], numQubits: Optional[int] = None) -> None:
+    qubits = [int(q) for q in qubits]
+    V.validate_multi_qubits(qureg, qubits, "applyQFT")
+    _apply_qft(qureg, qubits)
+
+
+def applyFullQFT(qureg: Qureg) -> None:
+    _apply_qft(qureg, list(range(qureg.num_qubits_represented)))
+
+
+def _apply_qft(qureg: Qureg, qubits) -> None:
+    n = len(qubits)
+    for q in range(n - 1, -1, -1):
+        hadamard(qureg, qubits[q])
+        if q == 0:
+            break
+        # fused controlled-phase ladder: theta = (pi/2^q) * x_low * x_q
+        regs = (tuple(qubits[:q]), (qubits[q],))
+        params = np.array([math.pi / (1 << q)])
+        inds = np.zeros((0, 2), np.int64)
+        phases = np.zeros((0,), np.float64)
+        qureg.amps = PF.apply_named_phase_func(
+            qureg.amps, _pad_params(params, PF.SCALED_PRODUCT, 2), inds, phases,
+            num_qubits=_sv_n(qureg), reg_qubits=regs, encoding=PF.UNSIGNED,
+            func_name=PF.SCALED_PRODUCT, conj=False,
+        )
+        if qureg.is_density_matrix:
+            sh = _shift(qureg)
+            sregs = (tuple(x + sh for x in regs[0]), tuple(x + sh for x in regs[1]))
+            qureg.amps = PF.apply_named_phase_func(
+                qureg.amps, _pad_params(params, PF.SCALED_PRODUCT, 2), inds, phases,
+                num_qubits=_sv_n(qureg), reg_qubits=sregs, encoding=PF.UNSIGNED,
+                func_name=PF.SCALED_PRODUCT, conj=True,
+            )
+        qureg.qasm_log.comment("here a controlled-phase ladder (QFT layer) was applied")
+    for i in range(n // 2):
+        swapGate(qureg, qubits[i], qubits[n - i - 1])
+
+
+# ---------------------------------------------------------------------------
+# QASM recording (QuEST.h:3351-3390)
+# ---------------------------------------------------------------------------
+
+
+def startRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.start()
+
+
+def stopRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.stop()
+
+
+def clearRecordedQASM(qureg: Qureg) -> None:
+    qureg.qasm_log.clear()
+
+
+def printRecordedQASM(qureg: Qureg) -> None:
+    print(str(qureg.qasm_log), end="")
+
+
+def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
+    try:
+        with open(filename, "w") as f:
+            f.write(str(qureg.qasm_log))
+    except OSError:
+        raise V.QuESTError(f"writeRecordedQASMToFile: Could not open file {filename}")

@@ -1,0 +1,126 @@
+"""Execution environment: device mesh discovery and seeding.
+
+TPU-native analogue of the reference's ``QuESTEnv`` (QuEST.h:361, {rank,
+numRanks}) and ``createQuESTEnv`` (MPI_Init + rank discovery,
+QuEST_cpu_distributed.c:129-160; GPU probe, QuEST_gpu.cu:446-478).  Instead
+of MPI ranks, the environment owns a 1-D ``jax.sharding.Mesh`` over the
+amplitude axis; a Qureg's amplitudes are sharded over it by their leading
+(most-significant-qubit) index bits — exactly the reference's chunk scheme
+(QuEST.h:330-338) expressed as a NamedSharding.  Multi-host TPU slices join
+the same mesh via ``jax.distributed`` (the analogue of MPI_Init), and the
+collectives ride ICI/DCN instead of MPI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import rng
+
+AMP_AXIS = "amps"
+
+
+@dataclasses.dataclass
+class QuESTEnv:
+    """Holds the device mesh. ``rank``/``num_ranks`` kept for reference-API
+    parity: rank = jax.process_index(), num_ranks = number of mesh devices."""
+
+    mesh: Mesh
+    rank: int
+    num_ranks: int
+    seeds: tuple
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    def amp_sharding(self) -> NamedSharding:
+        """For SoA state arrays (2, num_amps): shard the amplitude axis."""
+        return NamedSharding(self.mesh, PartitionSpec(None, AMP_AXIS))
+
+    def vec_sharding(self) -> NamedSharding:
+        """For flat per-amplitude vectors (e.g. DiagonalOp channels)."""
+        return NamedSharding(self.mesh, PartitionSpec(AMP_AXIS))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+def create_quest_env(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_devices: Optional[int] = None,
+) -> QuESTEnv:
+    """createQuESTEnv (QuEST.h:1851).
+
+    Uses all visible devices by default, truncated to the largest power of
+    two — the reference enforces power-of-2 ranks (validateNumRanks,
+    QuEST_validation.c:331-343) because amplitude chunks split on index bits;
+    the same constraint holds for the mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    pow2 = 1 << (n.bit_length() - 1)
+    devices = devices[:pow2]
+    mesh = Mesh(np.array(devices), (AMP_AXIS,))
+    env = QuESTEnv(
+        mesh=mesh,
+        rank=jax.process_index(),
+        num_ranks=pow2,
+        seeds=(),
+    )
+    seed_quest_default(env)
+    return env
+
+
+def destroy_quest_env(env: QuESTEnv) -> None:
+    """destroyQuESTEnv (QuEST.h:1864) — nothing to free; arrays are GC'd."""
+
+
+def sync_quest_env(env: QuESTEnv) -> None:
+    """syncQuESTEnv (QuEST.h:1875): the reference issues an MPI_Barrier /
+    cudaDeviceSynchronize.  XLA program order makes a barrier unnecessary;
+    we block on outstanding async dispatches for timing parity."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def sync_quest_success(success_code: int = 1) -> int:
+    """syncQuESTSuccess (QuEST_cpu_distributed.c:166-170) AND-reduces a flag
+    across ranks; single-process JAX returns it unchanged."""
+    return int(success_code)
+
+
+def report_quest_env(env: QuESTEnv) -> None:
+    print(get_environment_string(env))
+
+
+def get_environment_string(env: QuESTEnv) -> str:
+    """getEnvironmentString (QuEST.h:1912) — reference format:
+    'CUDA=.. OpenMP=.. MPI=.. threads=.. ranks=..'; ours reports the mesh."""
+    backend = jax.default_backend()
+    return (
+        f"EnvType=quest_tpu Backend={backend} Devices={env.num_devices} "
+        f"MeshAxes={AMP_AXIS} Processes={jax.process_count()}"
+    )
+
+
+def seed_quest(env: QuESTEnv, seeds: Sequence[int]) -> None:
+    """seedQuEST (QuEST.h:3341): seeds the measurement RNG identically on
+    every process (reference broadcasts the key,
+    QuEST_cpu_distributed.c:1384-1395; with jax.distributed every process
+    already passes the same seeds)."""
+    env.seeds = tuple(int(s) for s in seeds)
+    rng.GLOBAL_RNG.seed(env.seeds)
+
+
+def seed_quest_default(env: QuESTEnv) -> None:
+    """seedQuESTDefault (QuEST.h:3324): time+pid key."""
+    rng.GLOBAL_RNG.seed_default()
+    env.seeds = tuple(rng.GLOBAL_RNG._keys)

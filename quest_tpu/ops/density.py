@@ -1,0 +1,168 @@
+"""Density-matrix kernels: decoherence channels and rho-specific ops.
+
+A density matrix of n qubits is stored exactly as the reference stores it
+(QuEST.c:8-10, QuEST_common.c:9-11): a flattened 2n-qubit state-vector,
+column-major, ket qubits 0..n-1 (low index bits) and bra qubits n..2n-1.
+Unitaries on rho are the ket-op followed by the conjugated bra-twin
+(handled by the API layer); everything here is the rho-only kernel set
+(QuEST_internal.h:63-109 densmatr_*).
+
+Channels are realised through the Choi isomorphism: a Kraus map {K_k} on
+targets T becomes the dense superoperator sum_k conj(K_k) (x) K_k applied as
+an ordinary 2k-qubit matrix on targets (T, T+n) — the reference's own
+generic path (macro_populateKrausOperator, QuEST_common.c:595-652).  The
+one- and two-qubit dephasing channels additionally get fused elementwise
+fast paths (the reference's dedicated kernels, QuEST_cpu.c:48-123).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import cplx, gatedefs, kernels
+
+
+def superoperator_from_kraus(kraus_ops):
+    """sum_k conj(K_k) (x) K_k — acts on [bra-bits | ket-bits] of the
+    column-major vec(rho) (reference macro_populateKrausOperator,
+    QuEST_common.c:595-628).  Built host-side: at most 2^{2k} x 2^{2k}
+    NumPy work, entering the jitted kernel as a dynamic argument."""
+    s = None
+    for k in kraus_ops:
+        k = np.asarray(k, dtype=np.complex128)
+        term = np.kron(np.conj(k), k)
+        s = term if s is None else s + term
+    return s
+
+
+def kraus_targets(targets: Sequence[int], num_qubits: int) -> Tuple[int, ...]:
+    """Superoperator target list: ket targets then bra twins (t+n)."""
+    return tuple(targets) + tuple(t + num_qubits for t in targets)
+
+
+def apply_kraus_map(amps, kraus_ops, *, num_qubits: int, targets: Tuple[int, ...]):
+    """mixKrausMap / mixTwoQubitKrausMap / mixMultiQubitKrausMap
+    (QuEST_common.c:630-728)."""
+    s = superoperator_from_kraus(kraus_ops)
+    return kernels.apply_matrix(
+        amps,
+        cplx.soa(s),
+        num_qubits=2 * num_qubits,
+        targets=kraus_targets(targets, num_qubits),
+    )
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "target"), donate_argnums=0)
+def mix_dephasing(amps, prob, *, num_qubits: int, target: int):
+    """rho -> (1-p) rho + p Z rho Z: multiply elements whose ket/bra target
+    bits differ by (1-2p) — fused elementwise fast path
+    (densmatr_mixDephasing, QuEST_cpu.c:48-90).  Real factor: scales both
+    SoA channels identically."""
+    n = num_qubits
+    nn = 2 * n
+    view = amps.reshape((2,) + (2,) * nn)
+    prob = jnp.asarray(prob, amps.dtype)
+    sign = kernels.parity_sign(nn, (target, target + n), amps.dtype)
+    factor = (1 - prob) + prob * sign
+    return (view * factor[None]).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "qubit1", "qubit2"), donate_argnums=0)
+def mix_two_qubit_dephasing(amps, prob, *, num_qubits: int, qubit1: int, qubit2: int):
+    """rho -> (1-p) rho + p/3 (Z1 rho Z1 + Z2 rho Z2 + Z1Z2 rho Z1Z2)
+    (densmatr_mixTwoQubitDephasing, QuEST_cpu.c:92-123)."""
+    n = num_qubits
+    nn = 2 * n
+    view = amps.reshape((2,) + (2,) * nn)
+    prob = jnp.asarray(prob, amps.dtype)
+    s1 = kernels.parity_sign(nn, (qubit1, qubit1 + n), amps.dtype)
+    s2 = kernels.parity_sign(nn, (qubit2, qubit2 + n), amps.dtype)
+    factor = (1 - prob) + (prob / 3) * (s1 + s2 + s1 * s2)
+    return (view * factor[None]).reshape(2, -1)
+
+
+def depolarising_kraus(prob, dtype=None):
+    """{sqrt(1-p) I, sqrt(p/3) X, sqrt(p/3) Y, sqrt(p/3) Z}
+    (mixDepolarising definition, QuEST.h:3496)."""
+    p = float(prob)
+    return [
+        math.sqrt(1 - p) * gatedefs.PAULI_I,
+        math.sqrt(p / 3) * gatedefs.PAULI_X,
+        math.sqrt(p / 3) * gatedefs.PAULI_Y,
+        math.sqrt(p / 3) * gatedefs.PAULI_Z,
+    ]
+
+
+def damping_kraus(prob, dtype=None):
+    """Amplitude damping: K0 = diag(1, sqrt(1-p)), K1 = sqrt(p)|0><1|
+    (mixDamping, QuEST.h:3534)."""
+    p = float(prob)
+    k0 = np.array([[1, 0], [0, math.sqrt(1 - p)]], dtype=np.complex128)
+    k1 = np.array([[0, math.sqrt(p)], [0, 0]], dtype=np.complex128)
+    return [k0, k1]
+
+
+def pauli_kraus(prob_x, prob_y, prob_z, dtype=None):
+    """mixPauli -> 4 Kraus ops (reference densmatr_mixPauli via
+    QuEST_common.c:730-750)."""
+    p0 = 1 - float(prob_x) - float(prob_y) - float(prob_z)
+    return [
+        math.sqrt(p0) * gatedefs.PAULI_I,
+        math.sqrt(float(prob_x)) * gatedefs.PAULI_X,
+        math.sqrt(float(prob_y)) * gatedefs.PAULI_Y,
+        math.sqrt(float(prob_z)) * gatedefs.PAULI_Z,
+    ]
+
+
+def two_qubit_depolarising_kraus(prob, dtype=None):
+    """{sqrt(1-p) II} + {sqrt(p/15) P_i (x) P_j : (i,j) != (I,I)}
+    (mixTwoQubitDepolarising, QuEST.h:3601)."""
+    prob = float(prob)
+    ops = []
+    for i in range(4):
+        for j in range(4):
+            p = (1 - prob) if (i == 0 and j == 0) else prob / 15
+            # kron(second-qubit pauli, first-qubit pauli): targets[0] is the
+            # least-significant superop bit.
+            ops.append(
+                math.sqrt(p)
+                * np.kron(gatedefs.PAULI_MATRICES[j], gatedefs.PAULI_MATRICES[i])
+            )
+    return ops
+
+
+@partial(jax.jit, donate_argnums=0)
+def mix_density_matrix(amps, other_amps, prob):
+    """rho -> (1-p) rho + p rho_other (densmatr_mixDensityMatrix,
+    QuEST_cpu.c:125-160)."""
+    prob = jnp.asarray(prob, amps.dtype)
+    return (1 - prob) * amps + prob * other_amps
+
+
+@partial(jax.jit, static_argnames=("num_qubits",))
+def init_pure_state_density(psi_amps, *, num_qubits: int):
+    """rho = |psi><psi| flattened column-major: kron(conj(psi), psi)
+    (densmatr_initPureStateLocal outer product, QuEST_cpu.c:1184).
+    SoA: with u = conj(psi), re = kron(u0,p0) - kron(u1,p1), etc."""
+    p0, p1 = psi_amps[0], psi_amps[1]
+    re = jnp.kron(p0, p0) + jnp.kron(p1, p1)
+    im = jnp.kron(p0, p1) - jnp.kron(p1, p0)
+    return jnp.stack([re, im])
+
+
+@partial(jax.jit, static_argnames=("num_qubits",), donate_argnums=0)
+def apply_diagonal_op_density(amps, op_real, op_imag, *, num_qubits: int):
+    """Left-multiply D.rho: scale each column elementwise by D over ket bits
+    (densmatr_applyDiagonalOpLocal, QuEST_cpu.c:4042-4082). NOTE: this is the
+    `apply*` family — no conjugate twin (SURVEY.md §2.3 semantic trap)."""
+    dim = 1 << num_qubits
+    mat = amps.reshape(2, dim, dim)  # [channel, col, row]; rows are ket bits
+    f_re = op_real.astype(amps.dtype)[None, :]
+    f_im = op_imag.astype(amps.dtype)[None, :]
+    return cplx.cmul(mat, f_re, f_im).reshape(2, -1)

@@ -1,0 +1,129 @@
+"""Pauli-string application, expectation values.
+
+Re-implements the reference's workspace-based Pauli machinery
+(QuEST_common.c:505-569: clone + apply X/Y/Z kernels + inner product) the
+TPU way: a whole PauliHamil expectation is one jitted program — per term the
+Pauli product is applied with permutation/sign fast kernels (X = axis flip,
+Z = parity sign, Y = flip then +/-i sign; no dense 2x2 matmuls) and reduced
+against the original state, so XLA fuses and pipelines across terms instead
+of paying T full clone+dispatch round-trips.
+
+States are SoA ``(2, num_amps)`` real arrays (see ops/cplx.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import cplx
+
+PAULI_I, PAULI_X, PAULI_Y, PAULI_Z = 0, 1, 2, 3
+
+
+def apply_pauli_string(view, n: int, targets: Tuple[int, ...], codes: Tuple[int, ...]):
+    """Apply a Pauli product to a (2,) + (2,)*n SoA view using only flips
+    (X), broadcast sign masks (Z), and their composition
+    (Y: amp'_b = (+/-i) amp_{1-b}).
+
+    Matches statevec_applyPauliProd (QuEST_common.c:505-516) semantics.
+    """
+    flip_axes = []
+    factors = []  # (qubit-axis-sans-channel, re-vec or None, im-vec or None)
+    for t, c in zip(targets, codes):
+        ax = n - 1 - t  # axis in the channel-less (2,)*n layout
+        if c == PAULI_I:
+            continue
+        elif c == PAULI_X:
+            flip_axes.append(1 + ax)
+        elif c == PAULI_Z:
+            factors.append((ax, jnp.array([1.0, -1.0]), None))
+        elif c == PAULI_Y:
+            # Y|0> = i|1>, Y|1> = -i|0>: flip, then multiply by i*[-1, +1]
+            # indexed by the NEW bit value.
+            flip_axes.append(1 + ax)
+            factors.append((ax, None, jnp.array([-1.0, 1.0])))
+    if flip_axes:
+        view = jnp.flip(view, axis=tuple(flip_axes))
+    if factors:
+        f_re = jnp.ones((1,) * n, dtype=view.dtype)
+        f_im = jnp.zeros((1,) * n, dtype=view.dtype)
+        for ax, re_vec, im_vec in factors:
+            shape = [1] * n
+            shape[ax] = 2
+            if re_vec is not None:
+                v = re_vec.astype(view.dtype).reshape(shape)
+                f_re = f_re * v
+                f_im = f_im * v
+            else:
+                v = im_vec.astype(view.dtype).reshape(shape)
+                f_re, f_im = -f_im * v, f_re * v
+        view = cplx.cmul(view, f_re, f_im)
+    return view
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "targets", "codes"), donate_argnums=0)
+def apply_pauli_prod(amps, *, num_qubits: int, targets: Tuple[int, ...], codes: Tuple[int, ...]):
+    view = amps.reshape((2,) + (2,) * num_qubits)
+    return apply_pauli_string(view, num_qubits, targets, codes).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms"))
+def calc_expec_pauli_sum_statevec(amps, coeffs, *, num_qubits: int,
+                                  codes_flat: Tuple[int, ...], num_terms: int):
+    """Re <psi| sum_t c_t P_t |psi> as ONE fused program (reference loops
+    clone+apply+innerProduct per term, QuEST_common.c:534-546)."""
+    n = num_qubits
+    view = amps.reshape((2,) + (2,) * n)
+    coeffs = jnp.asarray(coeffs, amps.dtype)
+    total = jnp.zeros((), amps.dtype)
+    for t in range(num_terms):
+        codes = codes_flat[t * n:(t + 1) * n]
+        pv = apply_pauli_string(view, n, tuple(range(n)), codes)
+        # Re <view|pv>
+        total = total + coeffs[t] * jnp.sum(view[0] * pv[0] + view[1] * pv[1])
+    return total
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms"))
+def calc_expec_pauli_sum_density(amps, coeffs, *, num_qubits: int,
+                                 codes_flat: Tuple[int, ...], num_terms: int):
+    """Re Tr(rho sum_t c_t P_t): apply P to the ket qubits of the flattened
+    rho, then take the diagonal trace (reference routes this through
+    densmatr_calcTotalProb of a workspace, QuEST_common.c:519-546)."""
+    n = num_qubits
+    nn = 2 * n
+    dim = 1 << n
+    view = amps.reshape((2,) + (2,) * nn)
+    coeffs = jnp.asarray(coeffs, amps.dtype)
+    total = jnp.zeros((), amps.dtype)
+    for t in range(num_terms):
+        codes = codes_flat[t * n:(t + 1) * n]
+        pv = apply_pauli_string(view, nn, tuple(range(n)), codes)
+        tr_re = jnp.sum(jnp.diagonal(pv[0].reshape(dim, dim)))
+        total = total + coeffs[t] * tr_re
+    return total
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "num_state_qubits", "codes_flat", "num_terms"), donate_argnums=2)
+def apply_pauli_sum(amps, coeffs, out_amps, *, num_qubits: int,
+                    num_state_qubits: int, codes_flat: Tuple[int, ...],
+                    num_terms: int):
+    """out = sum_t c_t P_t |in> (statevec_applyPauliSum,
+    QuEST_common.c:547-569). NOTE apply*-family: on rho this left-multiplies
+    (SURVEY.md §2.3 semantic trap): num_state_qubits = 2*num_qubits and the
+    codes act on the ket (low) qubits only."""
+    n = num_qubits
+    nsv = num_state_qubits
+    view = amps.reshape((2,) + (2,) * nsv)
+    coeffs = jnp.asarray(coeffs, amps.dtype)
+    acc = jnp.zeros_like(view)
+    for t in range(num_terms):
+        codes = codes_flat[t * n:(t + 1) * n]
+        pv = apply_pauli_string(view, nsv, tuple(range(n)), codes)
+        acc = acc + coeffs[t] * pv
+    del out_amps  # donated buffer re-used by XLA for the result
+    return acc.reshape(2, -1)

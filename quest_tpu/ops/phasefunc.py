@@ -1,0 +1,234 @@
+"""Phase-function kernels: applyPhaseFunc / applyMultiVarPhaseFunc /
+applyNamedPhaseFunc (+Overrides, +Params variants).
+
+Re-implementation of the reference's per-amplitude phase kernels
+(QuEST_cpu.c:4228-4564): decode sub-register integers from global amplitude
+index bits, evaluate theta(x1..xm), multiply amp by exp(i*theta).  On TPU the
+decode is a handful of shift/and ops on a broadcast iota that XLA fuses with
+the complex multiply into one HBM sweep — phase functions are the single
+best-suited op family for this hardware (pure elementwise, zero
+communication under sharding: "embarrassingly parallel", QuEST_cpu.c:4414).
+
+Phase-function name codes match ``enum phaseFunc`` (QuEST.h:231-234).
+Divergence parameters and override matching follow
+statevec_applyParamNamedPhaseFuncOverrides (QuEST_cpu.c:4406-4564) exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import cplx
+from ..utils import bits
+
+# enum phaseFunc (QuEST.h:231-234)
+NORM = 0
+SCALED_NORM = 1
+INVERSE_NORM = 2
+SCALED_INVERSE_NORM = 3
+SCALED_INVERSE_SHIFTED_NORM = 4
+PRODUCT = 5
+SCALED_PRODUCT = 6
+INVERSE_PRODUCT = 7
+SCALED_INVERSE_PRODUCT = 8
+DISTANCE = 9
+SCALED_DISTANCE = 10
+INVERSE_DISTANCE = 11
+SCALED_INVERSE_DISTANCE = 12
+SCALED_INVERSE_SHIFTED_DISTANCE = 13
+
+UNSIGNED = 0
+TWOS_COMPLEMENT = 1
+
+_NORM_FUNCS = (NORM, SCALED_NORM, INVERSE_NORM, SCALED_INVERSE_NORM,
+               SCALED_INVERSE_SHIFTED_NORM)
+_PROD_FUNCS = (PRODUCT, SCALED_PRODUCT, INVERSE_PRODUCT, SCALED_INVERSE_PRODUCT)
+_DIST_FUNCS = (DISTANCE, SCALED_DISTANCE, INVERSE_DISTANCE,
+               SCALED_INVERSE_DISTANCE, SCALED_INVERSE_SHIFTED_DISTANCE)
+
+
+def _index_dtype(num_qubits: int):
+    return jnp.int64 if num_qubits > 31 else jnp.int32
+
+
+def _phase_inds(num_amps: int, reg_qubits, encoding: int, idx_dtype):
+    """Per-register decoded integer arrays, shape (num_regs, num_amps)."""
+    idx = bits.index_iota(num_amps, idx_dtype)
+    return [
+        bits.decode_subregister(idx, qs, encoding == TWOS_COMPLEMENT)
+        for qs in reg_qubits
+    ]
+
+
+def _apply_overrides(phase, inds, override_inds, override_phases):
+    """First-match-wins override scan (QuEST_cpu.c:4464-4480): iterate in
+    reverse so earlier entries overwrite later ones."""
+    num_overrides = override_inds.shape[0]
+    for i in range(num_overrides - 1, -1, -1):
+        match = jnp.ones(phase.shape, dtype=bool)
+        for r, ind_arr in enumerate(inds):
+            match = match & (ind_arr == override_inds[i, r])
+        phase = jnp.where(match, override_phases[i], phase)
+    return phase
+
+
+def _mul_phase(amps, phase, conj: bool):
+    """amp *= exp(i*phase) on the SoA state — explicit cos/sin, exactly the
+    reference's update (QuEST_cpu.c:4552-4562)."""
+    if conj:
+        phase = -phase
+    return cplx.cmul(amps, jnp.cos(phase), jnp.sin(phase))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_qubits", "reg_qubits", "encoding", "func_name", "conj"),
+    donate_argnums=0,
+)
+def apply_named_phase_func(
+    amps,
+    params,
+    override_inds,
+    override_phases,
+    *,
+    num_qubits: int,
+    reg_qubits: Tuple[Tuple[int, ...], ...],
+    encoding: int,
+    func_name: int,
+    conj: bool = False,
+):
+    num_amps = amps.shape[1]
+    idt = _index_dtype(num_qubits)
+    inds = _phase_inds(num_amps, reg_qubits, encoding, idt)
+    rdt = amps.dtype
+    params = jnp.asarray(params, rdt)
+    find = [x.astype(rdt) for x in inds]
+    num_regs = len(reg_qubits)
+
+    if func_name in _NORM_FUNCS:
+        acc = jnp.zeros((num_amps,), rdt)
+        for r in range(num_regs):
+            x = find[r]
+            if func_name == SCALED_INVERSE_SHIFTED_NORM:
+                x = x - params[2 + r]
+            acc = acc + x * x
+        val = jnp.sqrt(acc)
+        if func_name == NORM:
+            phase = val
+        elif func_name == INVERSE_NORM:
+            phase = jnp.where(val == 0, params[0], 1 / jnp.where(val == 0, 1, val))
+        elif func_name == SCALED_NORM:
+            phase = params[0] * val
+        else:  # SCALED_INVERSE_NORM, SCALED_INVERSE_SHIFTED_NORM
+            phase = jnp.where(val == 0, params[1], params[0] / jnp.where(val == 0, 1, val))
+    elif func_name in _PROD_FUNCS:
+        prod = jnp.ones((num_amps,), rdt)
+        for r in range(num_regs):
+            prod = prod * find[r]
+        if func_name == PRODUCT:
+            phase = prod
+        elif func_name == INVERSE_PRODUCT:
+            phase = jnp.where(prod == 0, params[0], 1 / jnp.where(prod == 0, 1, prod))
+        elif func_name == SCALED_PRODUCT:
+            phase = params[0] * prod
+        else:
+            phase = jnp.where(prod == 0, params[1], params[0] / jnp.where(prod == 0, 1, prod))
+    elif func_name in _DIST_FUNCS:
+        acc = jnp.zeros((num_amps,), rdt)
+        for r in range(0, num_regs, 2):
+            d = find[r + 1] - find[r]
+            if func_name == SCALED_INVERSE_SHIFTED_DISTANCE:
+                d = d - params[2 + r // 2]
+            acc = acc + d * d
+        val = jnp.sqrt(acc)
+        if func_name == DISTANCE:
+            phase = val
+        elif func_name == INVERSE_DISTANCE:
+            phase = jnp.where(val == 0, params[0], 1 / jnp.where(val == 0, 1, val))
+        elif func_name == SCALED_DISTANCE:
+            phase = params[0] * val
+        else:
+            phase = jnp.where(val == 0, params[1], params[0] / jnp.where(val == 0, 1, val))
+    else:
+        raise ValueError(f"unknown phase function {func_name}")
+
+    phase = _apply_overrides(phase, inds, override_inds, override_phases)
+    return _mul_phase(amps, phase, conj)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_qubits", "reg_qubits", "encoding", "terms_per_reg", "conj"),
+    donate_argnums=0,
+)
+def apply_multi_var_phase_func(
+    amps,
+    coeffs,
+    exponents,
+    override_inds,
+    override_phases,
+    *,
+    num_qubits: int,
+    reg_qubits: Tuple[Tuple[int, ...], ...],
+    encoding: int,
+    terms_per_reg: Tuple[int, ...],
+    conj: bool = False,
+):
+    """theta = sum_r sum_t coeff_{r,t} * x_r^exp_{r,t}
+    (statevec_applyMultiVarPhaseFuncOverrides, QuEST_cpu.c:4305-4404).
+    ``coeffs``/``exponents`` are flat over registers (reference layout)."""
+    num_amps = amps.shape[1]
+    idt = _index_dtype(num_qubits)
+    inds = _phase_inds(num_amps, reg_qubits, encoding, idt)
+    rdt = amps.dtype
+    coeffs = jnp.asarray(coeffs, rdt)
+    exponents = jnp.asarray(exponents, rdt)
+
+    phase = jnp.zeros((num_amps,), rdt)
+    flat = 0
+    for r in range(len(reg_qubits)):
+        x = inds[r].astype(rdt)
+        for _ in range(terms_per_reg[r]):
+            phase = phase + coeffs[flat] * jnp.power(x, exponents[flat])
+            flat += 1
+    phase = _apply_overrides(phase, inds, override_inds, override_phases)
+    return _mul_phase(amps, phase, conj)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_qubits", "qubits", "encoding", "conj"),
+    donate_argnums=0,
+)
+def apply_phase_func(
+    amps,
+    coeffs,
+    exponents,
+    override_inds,
+    override_phases,
+    *,
+    num_qubits: int,
+    qubits: Tuple[int, ...],
+    encoding: int,
+    conj: bool = False,
+):
+    """Single-register polynomial theta(x) = sum_i c_i x^{e_i}
+    (statevec_applyPhaseFuncOverrides, QuEST_cpu.c:4228-4303)."""
+    num_amps = amps.shape[1]
+    idt = _index_dtype(num_qubits)
+    idx = bits.index_iota(num_amps, idt)
+    ind = bits.decode_subregister(idx, qubits, encoding == TWOS_COMPLEMENT)
+    rdt = amps.dtype
+    coeffs = jnp.asarray(coeffs, rdt)
+    exponents = jnp.asarray(exponents, rdt)
+    x = ind.astype(rdt)
+    phase = jnp.zeros((num_amps,), rdt)
+    for i in range(coeffs.shape[0]):
+        phase = phase + coeffs[i] * jnp.power(x, exponents[i])
+    phase = _apply_overrides(phase, [ind], override_inds, override_phases)
+    return _mul_phase(amps, phase, conj)

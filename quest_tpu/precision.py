@@ -1,0 +1,65 @@
+"""Precision configuration for quest_tpu.
+
+TPU-native analogue of the reference's compile-time precision switch
+(``QuEST/include/QuEST_precision.h``): the reference selects ``qreal`` as
+float/double/long-double via the ``QuEST_PREC`` CMake cache variable
+(QuEST_precision.h:28-68).  Here precision is a *runtime* (trace-time)
+setting: new registers are created with the currently configured dtype.
+
+TPU hardware natively computes f32 (and bf16); f64 is software-emulated and
+~10x slower, so the TPU-first default is single precision.  Double precision
+is fully supported (enable ``jax.config.update("jax_enable_x64", True)``)
+and is what the test-suite oracle comparisons use on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+# Reference epsilon-per-precision (QuEST_precision.h:28-68): 1e-5 for single,
+# 1e-13 for double.  Used by unitarity / CPTP / probability validation.
+_REAL_EPS = {1: 1e-5, 2: 1e-13}
+
+# Reference cap on qubits in applyMultiVarPhaseFunc-style register lists
+# (QuEST_precision.h:72).
+MAX_NUM_REGS_APPLY_ARBITRARY_PHASE = 100
+
+
+@dataclasses.dataclass
+class _PrecisionState:
+    quest_prec: int = 1  # 1 = single (f32/c64), 2 = double (f64/c128)
+
+
+_state = _PrecisionState()
+
+
+def set_precision(quest_prec: int) -> None:
+    """Set the working precision: 1 = single (f32), 2 = double (f64).
+
+    Double precision requires x64 mode; this enables it on demand.
+    """
+    if quest_prec not in (1, 2):
+        raise ValueError("quest_prec must be 1 (single) or 2 (double)")
+    if quest_prec == 2:
+        jax.config.update("jax_enable_x64", True)
+    _state.quest_prec = quest_prec
+
+
+def get_precision() -> int:
+    return _state.quest_prec
+
+
+def real_dtype():
+    return jnp.float64 if _state.quest_prec == 2 else jnp.float32
+
+
+def complex_dtype():
+    return jnp.complex128 if _state.quest_prec == 2 else jnp.complex64
+
+
+def real_eps() -> float:
+    """Validation tolerance, matching QuEST_precision.h REAL_EPS."""
+    return _REAL_EPS[_state.quest_prec]

@@ -1,0 +1,108 @@
+"""Register and operator data structures.
+
+TPU-native analogues of the reference's user types:
+
+- ``Qureg`` (QuEST.h:322-353): the amplitude array is a single (possibly
+  sharded) on-HBM ``jax.Array`` instead of SoA real/imag C buffers; there is
+  no pairStateVec (the reference's 2x distributed receive buffer,
+  QuEST_cpu.c:1279-1315) because collective permutes materialize only
+  transient buffers, and no host mirror (the reference GPU backend keeps a
+  full CPU copy, QuEST_gpu.cu:275-319).
+- ``PauliHamil`` (QuEST.h:277): codes as an (terms, qubits) int array plus a
+  coefficient vector — device-resident so expectation values trace cleanly.
+- ``DiagonalOp`` (QuEST.h:297): a sharded complex diagonal kept as real+imag
+  pairs, mirroring the reference's SoA layout at the API level.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import precision
+from .env import QuESTEnv
+from .qasm import QASMLogger
+
+
+class Qureg:
+    """A quantum register: pure state-vector or density matrix.
+
+    ``amps`` is a real SoA array of shape (2, 2^numQubitsInStateVec)
+    (channel 0/1 = real/imag — the reference's ComplexArray layout,
+    QuEST.h:77; see ops/cplx.py for why this is the TPU-native choice),
+    sharded over the env's amplitude mesh on its amplitude axis by leading
+    (most-significant-bit) index — the reference's chunkId scheme
+    (QuEST.h:330-338) as a NamedSharding.
+    """
+
+    def __init__(self, num_qubits: int, env: QuESTEnv, is_density_matrix: bool):
+        self.is_density_matrix = bool(is_density_matrix)
+        self.num_qubits_represented = int(num_qubits)
+        self.num_qubits_in_state_vec = (2 if is_density_matrix else 1) * int(num_qubits)
+        self.env = env
+        self.dtype = precision.real_dtype()  # SoA channels are real arrays
+        self.qasm_log = QASMLogger(num_qubits)
+        self._amps: Optional[jax.Array] = None
+
+    # -- reference-parity metadata (QuEST.h:330-345) --
+    @property
+    def num_amps_total(self) -> int:
+        return 1 << self.num_qubits_in_state_vec
+
+    @property
+    def num_chunks(self) -> int:
+        return self.env.num_devices
+
+    @property
+    def num_amps_per_chunk(self) -> int:
+        return self.num_amps_total // self.num_chunks
+
+    @property
+    def amps(self) -> jax.Array:
+        return self._amps
+
+    @amps.setter
+    def amps(self, value: jax.Array):
+        self._amps = value
+
+    def sharding(self):
+        if self.num_amps_total >= self.env.num_devices:
+            return self.env.amp_sharding()
+        return self.env.replicated_sharding()
+
+    def device_put(self, amps) -> jax.Array:
+        return jax.device_put(jnp.asarray(amps, self.dtype), self.sharding())
+
+
+class PauliHamil:
+    """Real-weighted sum of Pauli products (QuEST.h:277)."""
+
+    def __init__(self, num_qubits: int, num_sum_terms: int):
+        self.num_qubits = int(num_qubits)
+        self.num_sum_terms = int(num_sum_terms)
+        self.pauli_codes = np.zeros((num_sum_terms, num_qubits), dtype=np.int32)
+        self.term_coeffs = np.zeros((num_sum_terms,), dtype=np.float64)
+
+
+class DiagonalOp:
+    """Diagonal operator on the full Hilbert space (QuEST.h:297).  Stored as
+    real+imag vectors (SoA like the reference) of length 2^numQubits, sharded
+    over the amplitude mesh by the same leading-bit scheme."""
+
+    def __init__(self, num_qubits: int, env: QuESTEnv):
+        self.num_qubits = int(num_qubits)
+        self.env = env
+        rdt = precision.real_dtype()
+        dim = 1 << self.num_qubits
+        sharding = (
+            env.vec_sharding() if dim >= env.num_devices else env.replicated_sharding()
+        )
+        self.real = jax.device_put(jnp.zeros((dim,), rdt), sharding)
+        self.imag = jax.device_put(jnp.zeros((dim,), rdt), sharding)
+
+    @property
+    def num_elems_per_chunk(self) -> int:
+        return (1 << self.num_qubits) // self.env.num_devices

@@ -1,0 +1,37 @@
+"""Measurement RNG.
+
+The reference uses a Mersenne Twister (mt19937ar.c) seeded from time+pid and
+broadcast so every rank draws identical outcomes (QuEST_common.c:195-227,
+QuEST_cpu_distributed.c:1384-1395).  Here we keep the same generator family
+(numpy's MT19937) for the imperative ``measure`` API — host-side sampling is
+inherently a device->host sync, matching the reference's semantics — and
+additionally expose key-based ``jax.random`` sampling for fully-jitted
+measurement (quest_tpu.ops.measurement), which the reference cannot do.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class _MeasurementRNG:
+    def __init__(self):
+        self.seed_default()
+
+    def seed(self, seeds: Sequence[int]) -> None:
+        self._keys = [int(s) & 0xFFFFFFFF for s in seeds]
+        self._rng = np.random.RandomState(np.random.MT19937(np.array(self._keys, dtype=np.uint32)))
+
+    def seed_default(self) -> None:
+        """time + pid default-key seeding (QuEST_common.c:195-217)."""
+        self.seed([int(time.time()), os.getpid()])
+
+    def uniform(self) -> float:
+        return float(self._rng.random_sample())
+
+
+GLOBAL_RNG = _MeasurementRNG()

@@ -1,0 +1,239 @@
+"""Input validation: the TPU-native re-implementation of the reference's
+validation layer (QuEST_validation.c: 80-code error enum :32-197, ~70
+validate* functions :331-984).
+
+The reference reports errors through the overridable weak symbol
+``invalidQuESTInputError`` which by default prints and exit(1)s
+(QuEST_validation.c:199-210); its test-suite overrides it to throw.  Here
+errors are always a raised ``QuESTError`` — the Pythonic equivalent of the
+overridden hook — and small-matrix numeric checks (unitarity to REAL_EPS,
+CPTP) run host-side on NumPy before any tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .precision import real_eps
+
+
+class QuESTError(ValueError):
+    """Raised on invalid user input (reference invalidQuESTInputError,
+    QuEST.h:5354)."""
+
+
+def _raise(msg: str, func: str):
+    raise QuESTError(f"{func}: {msg}")
+
+
+def validate_num_qubits(num_qubits: int, func: str):
+    if num_qubits <= 0:
+        _raise("Invalid number of qubits. Must create >0.", func)
+    if num_qubits > 62:
+        _raise("Invalid number of qubits. The maximum representable is 62.", func)
+
+
+def validate_target(qureg, target: int, func: str):
+    if target < 0 or target >= qureg.num_qubits_represented:
+        _raise("Invalid target qubit. Note that qubit indices begin with 0.", func)
+
+
+def validate_control_target(qureg, control: int, target: int, func: str):
+    validate_target(qureg, target, func)
+    validate_target(qureg, control, func)
+    if control == target:
+        _raise("Control qubit cannot equal target qubit.", func)
+
+
+def validate_unique_targets(qureg, qb1: int, qb2: int, func: str):
+    validate_target(qureg, qb1, func)
+    validate_target(qureg, qb2, func)
+    if qb1 == qb2:
+        _raise("Qubits must be unique.", func)
+
+
+def validate_multi_qubits(qureg, qubits: Sequence[int], func: str, what="qubits"):
+    if len(qubits) < 1 or len(qubits) > qureg.num_qubits_represented:
+        _raise(f"Invalid number of {what}. Must be >0 and <=numQubits.", func)
+    for q in qubits:
+        validate_target(qureg, q, func)
+    if len(set(qubits)) != len(qubits):
+        _raise(f"The {what} must be unique.", func)
+
+
+def validate_multi_controls_targets(
+    qureg, controls: Sequence[int], targets: Sequence[int], func: str
+):
+    validate_multi_qubits(qureg, targets, func, "target qubits")
+    if len(controls) > 0:
+        validate_multi_qubits(qureg, controls, func, "control qubits")
+    if set(controls) & set(targets):
+        _raise("Control qubits cannot equal target qubits.", func)
+
+
+def validate_control_states(controls, control_states, func: str):
+    for s in control_states:
+        if s not in (0, 1):
+            _raise("Invalid control-qubit state. Must be 0 or 1.", func)
+    if len(control_states) != len(controls):
+        _raise("Number of control states must match number of control qubits.", func)
+
+
+def validate_outcome(outcome: int, func: str):
+    if outcome not in (0, 1):
+        _raise("Invalid measurement outcome. Must be 0 or 1.", func)
+
+
+def validate_measurement_prob(prob: float, func: str):
+    if prob < real_eps():
+        _raise("Can't collapse to state with zero probability.", func)
+
+
+def validate_prob(prob: float, func: str, max_prob: float = 1.0, name="probability"):
+    if prob < 0 or prob > max_prob + real_eps():
+        _raise(f"Invalid {name}. Must be in [0, {max_prob}].", func)
+
+
+def validate_density_matrix(qureg, func: str):
+    if not qureg.is_density_matrix:
+        _raise("Operation valid only for density matrices.", func)
+
+
+def validate_state_vector(qureg, func: str):
+    if qureg.is_density_matrix:
+        _raise("Operation valid only for state-vectors.", func)
+
+
+def validate_matching_qureg_dims(q1, q2, func: str):
+    if q1.num_qubits_represented != q2.num_qubits_represented:
+        _raise("Dimensions of the qubit registers don't match.", func)
+
+
+def validate_matching_qureg_types(q1, q2, func: str):
+    if q1.is_density_matrix != q2.is_density_matrix:
+        _raise(
+            "Registers must both be state-vectors or both be density matrices.", func
+        )
+
+
+def _as_matrix(u) -> np.ndarray:
+    return np.asarray(u, dtype=np.complex128)
+
+
+def validate_matrix_size(u, num_targets: int, func: str):
+    m = _as_matrix(u)
+    dim = 1 << num_targets
+    if m.shape != (dim, dim):
+        _raise(
+            f"Matrix size (2^{num_targets} x 2^{num_targets}) doesn't match the "
+            "number of target qubits.",
+            func,
+        )
+
+
+def validate_unitary(u, num_targets: int, func: str):
+    """Unitarity to REAL_EPS (macro_isMatrixUnitary,
+    QuEST_validation.c:232-258)."""
+    validate_matrix_size(u, num_targets, func)
+    m = _as_matrix(u)
+    if not np.allclose(m @ m.conj().T, np.eye(m.shape[0]), atol=64 * real_eps()):
+        _raise("Matrix is not unitary.", func)
+
+
+def validate_unit_vector(x, y, z, func: str):
+    if abs(x) + abs(y) + abs(z) < real_eps():
+        _raise("Invalid axis. Must be a non-zero vector.", func)
+
+
+def validate_kraus_ops(ops, num_targets: int, func: str):
+    """CPTP check: sum K^dag K = I to REAL_EPS (validateKrausOps,
+    QuEST_validation.c)."""
+    if len(ops) < 1 or len(ops) > (1 << (2 * num_targets)):
+        _raise(
+            f"Invalid number of Kraus operators. Must be >0 and <= {1 << (2*num_targets)}.",
+            func,
+        )
+    dim = 1 << num_targets
+    acc = np.zeros((dim, dim), dtype=np.complex128)
+    for op in ops:
+        m = _as_matrix(op)
+        if m.shape != (dim, dim):
+            _raise("Invalid Kraus operator dimensions.", func)
+        acc += m.conj().T @ m
+    if not np.allclose(acc, np.eye(dim), atol=1024 * real_eps()):
+        _raise("The specified Kraus map is not completely positive and trace preserving (CPTP).", func)
+
+
+def validate_pauli_codes(codes, func: str):
+    for c in codes:
+        if int(c) not in (0, 1, 2, 3):
+            _raise(
+                "Invalid Pauli code. Codes must be 0 (I), 1 (X), 2 (Y) or 3 (Z).",
+                func,
+            )
+
+
+def validate_hamil_params(num_qubits: int, num_terms: int, func: str):
+    if num_qubits <= 0 or num_terms <= 0:
+        _raise("Invalid PauliHamil parameters. Must be >0.", func)
+
+
+def validate_pauli_hamil(hamil, func: str):
+    validate_hamil_params(hamil.num_qubits, hamil.num_sum_terms, func)
+    validate_pauli_codes(np.asarray(hamil.pauli_codes).ravel(), func)
+
+
+def validate_hamil_matches_qureg(hamil, qureg, func: str):
+    if hamil.num_qubits != qureg.num_qubits_represented:
+        _raise("PauliHamil dimensions don't match the qubit register.", func)
+
+
+def validate_diag_op_matches_qureg(op, qureg, func: str):
+    if op.num_qubits != qureg.num_qubits_represented:
+        _raise("DiagonalOp dimensions don't match the qubit register.", func)
+
+
+def validate_num_amps(qureg, start: int, num_amps: int, func: str):
+    if start < 0 or start >= qureg.num_amps_total:
+        _raise("Invalid amplitude index.", func)
+    if num_amps < 0 or start + num_amps > qureg.num_amps_total:
+        _raise("Invalid number of amplitudes.", func)
+
+
+def validate_trotter_params(order: int, reps: int, func: str):
+    if order <= 0 or (order % 2 and order != 1):
+        _raise("Invalid Trotter order. Must be 1, or an even number.", func)
+    if reps <= 0:
+        _raise("Invalid number of Trotter repetitions. Must be >=1.", func)
+
+
+def validate_phase_func_name(name: int, func: str):
+    if name < 0 or name > 13:
+        _raise("Invalid named phase function.", func)
+
+
+def validate_bit_encoding(encoding: int, func: str):
+    if encoding not in (0, 1):
+        _raise("Invalid bit encoding. Must be UNSIGNED (0) or TWOS_COMPLEMENT (1).", func)
+
+
+def validate_phase_func_overrides(num_regs_qubits, encoding, override_inds, func: str):
+    """Override indices must be representable by each sub-register's encoding
+    (validatePhaseFuncOverrides, QuEST_validation.c:753-984)."""
+    for ind_tuple in override_inds:
+        for nq, ind in zip(num_regs_qubits, ind_tuple):
+            if encoding == 0:
+                if ind < 0 or ind >= (1 << nq):
+                    _raise(
+                        "Invalid phase-function override index for the UNSIGNED encoding.",
+                        func,
+                    )
+            else:
+                half = 1 << (nq - 1)
+                if ind < -half or ind >= half:
+                    _raise(
+                        "Invalid phase-function override index for the TWOS_COMPLEMENT encoding.",
+                        func,
+                    )

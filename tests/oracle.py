@@ -1,0 +1,178 @@
+"""Dense NumPy reference simulator — the test oracle.
+
+Python analogue of the reference's independent test oracle
+(tests/utilities.{hpp,cpp}: QVector/QMatrix dense algebra, applyReferenceOp
+building the full 2^N operator via Kronecker products and multiplying it
+directly onto the state, utilities.cpp:304-360,728-791).  Deliberately
+naive O(4^N) linear algebra — correctness only, no shared code with
+quest_tpu kernels.
+
+Conventions: qubit q = bit q of the state index (little-endian).  A density
+matrix is a (2^N, 2^N) ndarray rho[r, c]; quest_tpu flattens column-major
+(ket = row = low bits), i.e. flat[r + c*2^N] = rho[r, c].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+I2 = np.eye(2, dtype=complex)
+X = np.array([[0, 1], [1, 0]], dtype=complex)
+Y = np.array([[0, -1j], [1j, 0]], dtype=complex)
+Z = np.array([[1, 0], [0, -1]], dtype=complex)
+H = np.array([[1, 1], [1, -1]], dtype=complex) / np.sqrt(2)
+PAULIS = (I2, X, Y, Z)
+
+
+def state_from_qureg(qureg) -> np.ndarray:
+    """Gather the (possibly sharded) amps to a host ndarray — the analogue of
+    the reference's MPI_Allgather toQVector (utilities.cpp:1085-1093)."""
+    soa = np.asarray(qureg.amps)
+    flat = soa[0] + 1j * soa[1]
+    if qureg.is_density_matrix:
+        dim = 1 << qureg.num_qubits_represented
+        return flat.reshape(dim, dim).T  # flat[r + c*dim] -> rho[r, c]
+    return flat
+
+
+def debug_state(num_amps: int) -> np.ndarray:
+    k = np.arange(num_amps)
+    return ((2 * k) % 10) / 10 + 1j * ((2 * k + 1) % 10) / 10
+
+
+def debug_density(num_qubits: int) -> np.ndarray:
+    dim = 1 << num_qubits
+    flat = debug_state(dim * dim)
+    return flat.reshape(dim, dim).T
+
+
+def full_operator(num_qubits: int, targets, matrix) -> np.ndarray:
+    """Expand a 2^k matrix on `targets` (targets[0] = least-significant
+    matrix bit) to the full 2^N operator (getFullOperatorMatrix,
+    utilities.cpp:304-360)."""
+    matrix = np.asarray(matrix, dtype=complex)
+    k = len(targets)
+    dim = 1 << num_qubits
+    op = np.zeros((dim, dim), dtype=complex)
+    for col in range(dim):
+        tbits = 0
+        for j, t in enumerate(targets):
+            tbits |= ((col >> t) & 1) << j
+        base = col
+        for t in targets:
+            base &= ~(1 << t)
+        for rbits in range(1 << k):
+            row = base
+            for j, t in enumerate(targets):
+                row |= ((rbits >> j) & 1) << t
+            op[row, col] = matrix[rbits, tbits]
+    return op
+
+
+def controlled_operator(num_qubits: int, controls, targets, matrix,
+                        control_states=None) -> np.ndarray:
+    """Full operator acting only where every control bit matches its state."""
+    dim = 1 << num_qubits
+    if control_states is None:
+        control_states = [1] * len(controls)
+    base = full_operator(num_qubits, targets, matrix)
+    op = np.eye(dim, dtype=complex)
+    for col in range(dim):
+        if all(((col >> c) & 1) == s for c, s in zip(controls, control_states)):
+            op[:, col] = base[:, col]
+    return op
+
+
+def apply_to_statevec(state, num_qubits, targets, matrix, controls=(),
+                      control_states=None) -> np.ndarray:
+    op = controlled_operator(num_qubits, controls, targets, matrix, control_states)
+    return op @ state
+
+
+def apply_to_density(rho, num_qubits, targets, matrix, controls=(),
+                     control_states=None) -> np.ndarray:
+    op = controlled_operator(num_qubits, controls, targets, matrix, control_states)
+    return op @ rho @ op.conj().T
+
+
+def apply_kraus_to_density(rho, num_qubits, targets, kraus_ops) -> np.ndarray:
+    out = np.zeros_like(rho)
+    for k in kraus_ops:
+        op = full_operator(num_qubits, targets, k)
+        out += op @ rho @ op.conj().T
+    return out
+
+
+def pauli_product(num_qubits: int, targets, codes) -> np.ndarray:
+    return full_operator(
+        num_qubits, list(targets), _pauli_matrix_on_targets(codes)
+    )
+
+
+def _pauli_matrix_on_targets(codes):
+    m = None
+    for c in codes:
+        p = PAULIS[int(c)]
+        m = p if m is None else np.kron(p, m)
+    return m
+
+
+def pauli_sum_matrix(num_qubits: int, codes_2d, coeffs) -> np.ndarray:
+    dim = 1 << num_qubits
+    total = np.zeros((dim, dim), dtype=complex)
+    for t, coeff in enumerate(coeffs):
+        total += coeff * pauli_product(
+            num_qubits, list(range(num_qubits)), codes_2d[t]
+        )
+    return total
+
+
+def dft_matrix(num_qubits: int) -> np.ndarray:
+    """QFT oracle (getDFT, utilities.cpp:652): amp_y = 1/sqrt(N) sum_x
+    e^{2 pi i x y / N}."""
+    dim = 1 << num_qubits
+    x, y = np.meshgrid(np.arange(dim), np.arange(dim))
+    return np.exp(2j * np.pi * x * y / dim) / np.sqrt(dim)
+
+
+def random_state(num_qubits: int, rng) -> np.ndarray:
+    dim = 1 << num_qubits
+    v = rng.standard_normal(dim) + 1j * rng.standard_normal(dim)
+    return v / np.linalg.norm(v)
+
+
+def random_density(num_qubits: int, rng) -> np.ndarray:
+    """Random mixed state (getRandomDensityMatrix, utilities.hpp:398)."""
+    dim = 1 << num_qubits
+    a = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+    rho = a @ a.conj().T
+    return rho / np.trace(rho)
+
+
+def random_unitary(num_targets: int, rng) -> np.ndarray:
+    """Haar-ish unitary via QR (getRandomUnitary, utilities.cpp:530)."""
+    dim = 1 << num_targets
+    a = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+    q, r = np.linalg.qr(a)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def random_kraus_map(num_targets: int, num_ops: int, rng):
+    """Random CPTP map (getRandomKrausMap, utilities.cpp:578)."""
+    dim = 1 << num_targets
+    ops = [rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+           for _ in range(num_ops)]
+    total = sum(k.conj().T @ k for k in ops)
+    # normalise: S^{-1/2} K_i satisfies CPTP
+    w, v = np.linalg.eigh(total)
+    inv_sqrt = v @ np.diag(1 / np.sqrt(w)) @ v.conj().T
+    return [k @ inv_sqrt for k in ops]
+
+
+def set_qureg_from_array(qt, qureg, array) -> None:
+    """Load an oracle state into a quest_tpu register."""
+    if qureg.is_density_matrix:
+        flat = np.asarray(array).T.ravel()  # rho[r,c] -> flat[r + c*dim]
+        qt.setDensityAmps(qureg, flat.real, flat.imag)
+    else:
+        qt.initStateFromAmps(qureg, np.asarray(array).real, np.asarray(array).imag)

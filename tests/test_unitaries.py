@@ -1,0 +1,361 @@
+"""Unitary-gate correctness vs the dense oracle — the analogue of the
+reference's test_unitaries.cpp (41 TEST_CASEs, exhaustive GENERATE over
+target/control combinations on 5-qubit debug states applied to both a
+state-vector and a density matrix)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+ATOL = 1e-10
+
+
+def check_gate(env, apply_fn, targets, matrix, controls=(), control_states=None):
+    """Apply via API to psi and rho; compare against dense oracle."""
+    psi = qt.createQureg(N, env)
+    qt.initDebugState(psi)
+    apply_fn(psi)
+    ref = oracle.apply_to_statevec(
+        oracle.debug_state(2 ** N), N, targets, matrix, controls, control_states
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(psi), ref, atol=ATOL)
+
+    rho = qt.createDensityQureg(N, env)
+    qt.initDebugState(rho)
+    apply_fn(rho)
+    ref_r = oracle.apply_to_density(
+        oracle.debug_density(N), N, targets, matrix, controls, control_states
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(rho), ref_r, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# one-qubit gates, exhaustive over targets
+# ---------------------------------------------------------------------------
+
+S = np.diag([1, 1j]).astype(complex)
+T = np.diag([1, np.exp(1j * np.pi / 4)]).astype(complex)
+
+
+@pytest.mark.parametrize("target", range(N))
+@pytest.mark.parametrize(
+    "name,fn,matrix",
+    [
+        ("hadamard", lambda q, t: qt.hadamard(q, t), oracle.H),
+        ("pauliX", lambda q, t: qt.pauliX(q, t), oracle.X),
+        ("pauliY", lambda q, t: qt.pauliY(q, t), oracle.Y),
+        ("pauliZ", lambda q, t: qt.pauliZ(q, t), oracle.Z),
+        ("sGate", lambda q, t: qt.sGate(q, t), S),
+        ("tGate", lambda q, t: qt.tGate(q, t), T),
+    ],
+)
+def test_fixed_single_qubit_gates(env, name, fn, matrix, target):
+    check_gate(env, lambda q: fn(q, target), [target], matrix)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_rotations(env, target):
+    theta = 0.671
+    rx = np.array(
+        [[np.cos(theta / 2), -1j * np.sin(theta / 2)],
+         [-1j * np.sin(theta / 2), np.cos(theta / 2)]]
+    )
+    ry = np.array(
+        [[np.cos(theta / 2), -np.sin(theta / 2)],
+         [np.sin(theta / 2), np.cos(theta / 2)]]
+    )
+    rz = np.diag([np.exp(-0.5j * theta), np.exp(0.5j * theta)])
+    check_gate(env, lambda q: qt.rotateX(q, target, theta), [target], rx)
+    check_gate(env, lambda q: qt.rotateY(q, target, theta), [target], ry)
+    check_gate(env, lambda q: qt.rotateZ(q, target, theta), [target], rz)
+    check_gate(
+        env,
+        lambda q: qt.phaseShift(q, target, theta),
+        [target],
+        np.diag([1, np.exp(1j * theta)]),
+    )
+
+
+def test_rotate_around_axis(env):
+    theta, axis = 1.23, (1.0, -2.0, 0.5)
+    n = np.array(axis) / np.linalg.norm(axis)
+    m = (
+        np.cos(theta / 2) * oracle.I2
+        - 1j * np.sin(theta / 2) * (n[0] * oracle.X + n[1] * oracle.Y + n[2] * oracle.Z)
+    )
+    check_gate(env, lambda q: qt.rotateAroundAxis(q, 2, theta, axis), [2], m)
+    check_gate(
+        env,
+        lambda q: qt.rotateAroundAxis(q, 1, theta, qt.Vector(*axis)),
+        [1],
+        m,
+    )
+
+
+def test_compact_unitary(env):
+    alpha = 0.6 + 0.48j
+    beta = 0.36 - 0.48j  # |a|^2+|b|^2 = 0.9252... must be 1; normalise
+    norm = np.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    m = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    check_gate(env, lambda q: qt.compactUnitary(q, 3, alpha, beta), [3], m)
+
+
+def test_unitary_random(env):
+    rng = np.random.default_rng(0)
+    u = oracle.random_unitary(1, rng)
+    for t in range(N):
+        check_gate(env, lambda q, t=t: qt.unitary(q, t, u), [t], u)
+
+
+# ---------------------------------------------------------------------------
+# controlled gates, exhaustive over (control, target) pairs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "ctrl,target", [(c, t) for c in range(N) for t in range(N) if c != t]
+)
+def test_controlled_not_y(env, ctrl, target):
+    check_gate(env, lambda q: qt.controlledNot(q, ctrl, target), [target], oracle.X, [ctrl])
+    check_gate(env, lambda q: qt.controlledPauliY(q, ctrl, target), [target], oracle.Y, [ctrl])
+
+
+@pytest.mark.parametrize("ctrl,target", [(0, 4), (3, 1), (2, 0)])
+def test_controlled_rotations(env, ctrl, target):
+    theta = -0.37
+    rx = np.array(
+        [[np.cos(theta / 2), -1j * np.sin(theta / 2)],
+         [-1j * np.sin(theta / 2), np.cos(theta / 2)]]
+    )
+    rz = np.diag([np.exp(-0.5j * theta), np.exp(0.5j * theta)])
+    check_gate(env, lambda q: qt.controlledRotateX(q, ctrl, target, theta), [target], rx, [ctrl])
+    check_gate(env, lambda q: qt.controlledRotateZ(q, ctrl, target, theta), [target], rz, [ctrl])
+    check_gate(
+        env,
+        lambda q: qt.controlledPhaseShift(q, ctrl, target, theta),
+        [target],
+        np.diag([1, np.exp(1j * theta)]),
+        [ctrl],
+    )
+    check_gate(
+        env,
+        lambda q: qt.controlledPhaseFlip(q, ctrl, target),
+        [target],
+        np.diag([1, -1]),
+        [ctrl],
+    )
+
+
+def test_controlled_unitary_random(env):
+    rng = np.random.default_rng(1)
+    u = oracle.random_unitary(1, rng)
+    check_gate(env, lambda q: qt.controlledUnitary(q, 1, 3, u), [3], u, [1])
+    check_gate(
+        env, lambda q: qt.multiControlledUnitary(q, [0, 2, 4], 3, u), [3], u, [0, 2, 4]
+    )
+
+
+def test_multi_state_controlled_unitary(env):
+    rng = np.random.default_rng(2)
+    u = oracle.random_unitary(1, rng)
+    check_gate(
+        env,
+        lambda q: qt.multiStateControlledUnitary(q, [0, 2], [0, 1], 3, u),
+        [3],
+        u,
+        [0, 2],
+        [0, 1],
+    )
+
+
+def test_multi_controlled_phase(env):
+    theta = 0.8
+    check_gate(
+        env,
+        lambda q: qt.multiControlledPhaseShift(q, [0, 2, 3], theta),
+        [3],
+        np.diag([1, np.exp(1j * theta)]),
+        [0, 2],
+    )
+    check_gate(
+        env,
+        lambda q: qt.multiControlledPhaseFlip(q, [1, 2, 4]),
+        [4],
+        np.diag([1, -1]),
+        [1, 2],
+    )
+
+
+# ---------------------------------------------------------------------------
+# NOT / swap families
+# ---------------------------------------------------------------------------
+
+
+def test_multi_qubit_not(env):
+    x2 = np.kron(oracle.X, oracle.X)
+    check_gate(env, lambda q: qt.multiQubitNot(q, [1, 3]), [1, 3], x2)
+    check_gate(
+        env,
+        lambda q: qt.multiControlledMultiQubitNot(q, [0, 4], [1, 3]),
+        [1, 3],
+        x2,
+        [0, 4],
+    )
+
+
+SWAP = np.array(
+    [[1, 0, 0, 0], [0, 0, 1, 0], [0, 1, 0, 0], [0, 0, 0, 1]], dtype=complex
+)
+SQRT_SWAP = np.array(
+    [
+        [1, 0, 0, 0],
+        [0, 0.5 + 0.5j, 0.5 - 0.5j, 0],
+        [0, 0.5 - 0.5j, 0.5 + 0.5j, 0],
+        [0, 0, 0, 1],
+    ]
+)
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (1, 4), (3, 2), (4, 0)])
+def test_swap_gates(env, q1, q2):
+    check_gate(env, lambda q: qt.swapGate(q, q1, q2), [q1, q2], SWAP)
+    check_gate(env, lambda q: qt.sqrtSwapGate(q, q1, q2), [q1, q2], SQRT_SWAP)
+
+
+# ---------------------------------------------------------------------------
+# multi-qubit rotations
+# ---------------------------------------------------------------------------
+
+
+def _multi_z_matrix(k, theta):
+    """exp(-i theta/2 Z x ... x Z) on k qubits."""
+    signs = np.ones(1)
+    for _ in range(k):
+        signs = np.concatenate([signs, -signs])
+    return np.diag(np.exp(-0.5j * theta * signs))
+
+
+@pytest.mark.parametrize("qubits", [[0], [1, 3], [0, 2, 4], [0, 1, 2, 3, 4]])
+def test_multi_rotate_z(env, qubits):
+    theta = 0.91
+    check_gate(
+        env,
+        lambda q: qt.multiRotateZ(q, qubits, theta),
+        qubits,
+        _multi_z_matrix(len(qubits), theta),
+    )
+
+
+def test_multi_controlled_multi_rotate_z(env):
+    theta = -1.3
+    check_gate(
+        env,
+        lambda q: qt.multiControlledMultiRotateZ(q, [0, 4], [1, 3], theta),
+        [1, 3],
+        _multi_z_matrix(2, theta),
+        [0, 4],
+    )
+
+
+@pytest.mark.parametrize(
+    "targets,paulis",
+    [([0], [1]), ([1], [2]), ([2], [3]), ([0, 2], [1, 2]), ([1, 3, 4], [3, 1, 2]),
+     ([0, 1], [2, 2]), ([2, 4], [0, 1])],
+)
+def test_multi_rotate_pauli(env, targets, paulis):
+    theta = 0.77
+    from scipy.linalg import expm
+
+    p = oracle._pauli_matrix_on_targets(paulis)
+    m = expm(-0.5j * theta * p)
+    check_gate(
+        env, lambda q: qt.multiRotatePauli(q, targets, paulis, theta), targets, m
+    )
+
+
+def test_multi_controlled_multi_rotate_pauli(env):
+    theta = 0.52
+    from scipy.linalg import expm
+
+    paulis = [1, 3]
+    p = oracle._pauli_matrix_on_targets(paulis)
+    m = expm(-0.5j * theta * p)
+    check_gate(
+        env,
+        lambda q: qt.multiControlledMultiRotatePauli(q, [0, 2], [1, 4], paulis, theta),
+        [1, 4],
+        m,
+        [0, 2],
+    )
+
+
+# ---------------------------------------------------------------------------
+# dense 2/N-qubit unitaries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("t1,t2", [(0, 1), (2, 4), (3, 0), (4, 1)])
+def test_two_qubit_unitary(env, t1, t2):
+    rng = np.random.default_rng(3)
+    u = oracle.random_unitary(2, rng)
+    check_gate(env, lambda q: qt.twoQubitUnitary(q, t1, t2, u), [t1, t2], u)
+
+
+def test_controlled_two_qubit_unitary(env):
+    rng = np.random.default_rng(4)
+    u = oracle.random_unitary(2, rng)
+    check_gate(env, lambda q: qt.controlledTwoQubitUnitary(q, 2, 0, 3, u), [0, 3], u, [2])
+    check_gate(
+        env,
+        lambda q: qt.multiControlledTwoQubitUnitary(q, [1, 2], 0, 3, u),
+        [0, 3],
+        u,
+        [1, 2],
+    )
+
+
+@pytest.mark.parametrize("targets", [[0], [2, 0], [1, 3, 4], [3, 0, 2, 1]])
+def test_multi_qubit_unitary(env, targets):
+    rng = np.random.default_rng(5)
+    u = oracle.random_unitary(len(targets), rng)
+    check_gate(env, lambda q: qt.multiQubitUnitary(q, targets, u), targets, u)
+
+
+def test_controlled_multi_qubit_unitary(env):
+    rng = np.random.default_rng(6)
+    u = oracle.random_unitary(2, rng)
+    check_gate(env, lambda q: qt.controlledMultiQubitUnitary(q, 4, [1, 0], u), [1, 0], u, [4])
+    check_gate(
+        env,
+        lambda q: qt.multiControlledMultiQubitUnitary(q, [4, 2], [1, 0], u),
+        [1, 0],
+        u,
+        [4, 2],
+    )
+
+
+# ---------------------------------------------------------------------------
+# input validation (reference SECTION("input validation") pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_validation_errors(env):
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.hadamard(q, N)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.hadamard(q, -1)
+    with pytest.raises(qt.QuESTError, match="Control qubit cannot equal target"):
+        qt.controlledNot(q, 2, 2)
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.multiQubitNot(q, [1, 1])
+    with pytest.raises(qt.QuESTError, match="not unitary"):
+        qt.unitary(q, 0, np.array([[1, 0], [0, 2]]))
+    with pytest.raises(qt.QuESTError, match="Control qubits cannot equal target"):
+        qt.multiControlledUnitary(q, [1, 2], 2, np.eye(2))
