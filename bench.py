@@ -1,0 +1,95 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Workload = BASELINE.json config 2: 26-qubit state-vector, depth-20 random
+circuit of 1q unitaries + CNOT ladder, single chip, whole circuit traced
+into one jitted XLA program.  Metric: amplitude-updates per second
+(gates x 2^N / wall-clock) — the gate-apply rate of BASELINE.json.
+
+vs_baseline compares against the reference QuEST CPU backend (upstream
+sagudeloo/QuEST built -DMULTITHREADED=1, Release, double precision) running
+the IDENTICAL circuit shape on the build host (single hardware core —
+see BASELINE.md for the measured record).
+"""
+
+import json
+import os
+import sys
+import time
+
+# quest_tpu imports resolve from this file's directory. (If you need
+# PYTHONPATH instead, APPEND to it — replacing it drops /root/.axon_site
+# and breaks axon TPU plugin discovery; see .claude/skills/verify/SKILL.md.)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+if os.environ.get("QT_BENCH_CPU") == "1":
+    # local testing off-TPU; NB the JAX_PLATFORMS env var hangs under the
+    # axon relay, the config update is the reliable route
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import quest_tpu as qt
+from quest_tpu.models import circuits
+from quest_tpu.ops import calculations, kernels
+
+# Reference QuEST CPU (this repo's build host, 1 core, f64), same circuit:
+# {"n": 26, "depth": 20, "gates": 770, ...} — measured value recorded in
+# BASELINE.md. amp-updates/sec:
+BASELINE_AMPS_PER_SEC = 3.17e8
+
+N = int(os.environ.get("QT_BENCH_QUBITS", "26"))
+DEPTH = int(os.environ.get("QT_BENCH_DEPTH", "20"))
+REPS = int(os.environ.get("QT_BENCH_REPS", "3"))
+
+
+def main():
+    fn, unitaries = circuits.build_random_circuit(N, DEPTH, seed=7)
+
+    def program(amps, us):
+        amps = fn(amps, us)
+        prob = calculations.calc_prob_of_outcome_statevec(
+            amps, num_qubits=N, target=N - 1, outcome=0
+        )
+        return amps, prob
+
+    jprog = jax.jit(program, donate_argnums=0)
+
+    num_gates = DEPTH * N + sum(
+        1 for d in range(DEPTH) for t in range(N - 1) if (d + t) % 2 == 0
+    )
+
+    amps = kernels.init_zero_state(1 << N, np.float32)
+    # warm-up (compile)
+    amps, prob = jprog(amps, unitaries)
+    prob.block_until_ready()
+
+    times = []
+    for _ in range(REPS):
+        amps = kernels.init_zero_state(1 << N, np.float32)
+        t0 = time.perf_counter()
+        amps, prob = jprog(amps, unitaries)
+        prob.block_until_ready()
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    value = num_gates * float(1 << N) / best
+    print(
+        json.dumps(
+            {
+                "metric": f"{N}q depth-{DEPTH} random-circuit gate-apply rate",
+                "value": value,
+                "unit": "amp_updates_per_sec",
+                "vs_baseline": value / BASELINE_AMPS_PER_SEC,
+                "seconds": best,
+                "gates": num_gates,
+                "backend": jax.default_backend(),
+                "prob_check": float(prob),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ from . import rng as _rng
 from . import validation as V
 from .ops import calculations as C
 from .ops import cplx as CX
+from .parallel import dist as PAR
 from .ops import density as D
 from .ops import gatedefs as G
 from .ops import kernels as K
@@ -228,7 +229,7 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
     rdt = real_dtype()
     dim = 1 << op.num_qubits
     sharding = (
-        op.env.amp_sharding()
+        op.env.vec_sharding()
         if dim >= op.env.num_devices
         else op.env.replicated_sharding()
     )
@@ -385,6 +386,45 @@ def _shift(qureg: Qureg) -> int:
     return qureg.num_qubits_represented
 
 
+def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
+    """Route a dense-matrix gate: explicit ppermute path for sharded target
+    qubits (the reference's Distributed kernels), ordinary kernel (GSPMD
+    propagation) otherwise — the locality predicate of
+    QuEST_cpu_distributed.c:366-371 as a trace-time branch."""
+    env = qureg.env
+    n = _sv_n(qureg)
+    ndev = env.num_devices
+    amps = qureg.amps
+    if ndev > 1 and (1 << n) > ndev and PAR.explicit_dist_enabled():
+        nloc = n - PAR.num_shard_bits(env.mesh)
+        high = [t for t in targets if t >= nloc]
+        if high and len(targets) == 1:
+            return PAR.apply_matrix_1q_sharded(
+                amps, stacked, mesh=env.mesh, num_qubits=n, target=targets[0],
+                controls=controls, control_states=control_states,
+            )
+        if high:
+            swaps, new_targets = PAR.plan_relocalization(n, nloc, targets, controls)
+            if swaps is not None:
+                for lo, hi in swaps:
+                    amps = PAR.swap_sharded(
+                        amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
+                    )
+                amps = K.apply_matrix(
+                    amps, stacked, num_qubits=n, targets=new_targets,
+                    controls=controls, control_states=control_states,
+                )
+                for lo, hi in reversed(swaps):
+                    amps = PAR.swap_sharded(
+                        amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
+                    )
+                return amps
+    return K.apply_matrix(
+        amps, stacked, num_qubits=n, targets=targets,
+        controls=controls, control_states=control_states,
+    )
+
+
 def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     """Kernel on ket qubits; conjugated twin on bra qubits for rho
     (QuEST.c:181-183).  ``matrix`` is host complex; stacked to SoA here."""
@@ -392,18 +432,16 @@ def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
     stacked = CX.soa(matrix)
-    qureg.amps = K.apply_matrix(
-        qureg.amps, stacked, num_qubits=_sv_n(qureg), targets=targets,
-        controls=controls, control_states=control_states,
-    )
+    qureg.amps = _dispatch_matrix(qureg, stacked, targets, controls, control_states)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
         conj_stacked = np.stack([stacked[0], -stacked[1]])
-        qureg.amps = K.apply_matrix(
-            qureg.amps, conj_stacked, num_qubits=_sv_n(qureg),
-            targets=tuple(t + sh for t in targets),
-            controls=tuple(c + sh for c in controls),
-            control_states=control_states,
+        qureg.amps = _dispatch_matrix(
+            qureg,
+            conj_stacked,
+            tuple(t + sh for t in targets),
+            tuple(c + sh for c in controls),
+            control_states,
         )
 
 

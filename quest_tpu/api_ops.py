@@ -284,8 +284,8 @@ def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
                 qureg.amps, pureState.amps, num_qubits=qureg.num_qubits_represented
             )
         )
-    ip = C.calc_inner_product(qureg.amps, pureState.amps)
-    return abs(ip) ** 2
+    ip = np.asarray(C.calc_inner_product(qureg.amps, pureState.amps))
+    return float(ip[0] ** 2 + ip[1] ** 2)
 
 
 def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:

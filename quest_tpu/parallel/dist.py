@@ -1,0 +1,254 @@
+"""Explicit distributed kernels: shard_map + ppermute over the amplitude mesh.
+
+TPU-native re-design of the reference's MPI orchestration layer
+(QuEST/src/CPU/QuEST_cpu_distributed.c).  The state of n qubits is sharded
+over a 1-D device mesh on its leading (most-significant) index bits: with
+2^r devices, qubits 0..n-r-1 are *local* (inside each shard) and qubits
+n-r..n-1 are *sharded* (their bit IS a mesh-coordinate bit) — exactly the
+reference's chunkId scheme (QuEST.h:330-338).
+
+Mapping of the reference's five MPI primitives (SURVEY.md §5.8):
+
+- pairwise full-chunk ``MPI_Sendrecv`` with the XOR-partner rank
+  (exchangeStateVectors, :489-517) -> ``lax.ppermute`` with the static
+  hypercube permutation [(i, i ^ 2^b)];
+- the locality predicate target < log2(chunkSize)
+  (halfMatrixBlockFitsInChunk, :366-371) -> a Python-level static branch:
+  local targets run the ordinary kernels un-communicated;
+- SWAP-relocalization of multi-qubit ops (:1447-1545) -> half-shard
+  ppermute swaps (``swap_sharded``) pulling high targets down to free low
+  qubits, op applied locally, swaps undone;
+- ``MPI_Allreduce`` (:35-117) -> ``lax.psum``;
+- ``MPI_Bcast`` replication loops (:379-423) -> ``lax.all_gather``.
+
+Two structural wins over the reference: no pairStateVec — the reference
+permanently holds a 2x receive buffer (QuEST_cpu.c:1279-1315) while
+ppermute's transient buffer exists only inside one fused program; and the
+elementwise combine fuses with the communication epilogue under XLA instead
+of being a second pass over memory.
+
+These kernels are *compile-time* alternatives invoked by the API layer when
+a gate touches sharded qubits (quest_tpu.api routes there); the GSPMD path
+(plain jit + sharding propagation) remains available via
+``use_explicit_dist(False)`` for benchmarking one against the other
+(SURVEY.md §7 layer 5 calls for exactly this comparison).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..env import AMP_AXIS
+from ..ops import cplx
+
+_CONFIG = {"explicit": True}
+
+
+def use_explicit_dist(enabled: bool) -> None:
+    """Toggle the explicit ppermute path vs GSPMD propagation."""
+    _CONFIG["explicit"] = bool(enabled)
+
+
+def explicit_dist_enabled() -> bool:
+    return _CONFIG["explicit"]
+
+
+def amp_axis_size(mesh: Mesh) -> int:
+    """Size of the amplitude axis — NOT mesh.devices.size: meshes may carry
+    extra axes (e.g. the (dp, amps) training mesh)."""
+    return int(mesh.shape[AMP_AXIS])
+
+
+def num_shard_bits(mesh: Mesh) -> int:
+    return int(math.log2(amp_axis_size(mesh)))
+
+
+def _hypercube_perm(ndev: int, bit: int):
+    """Static XOR-partner permutation — the reference's pair-rank computation
+    chunkId ^ (2^t / chunkSize) (QuEST_cpu_distributed.c:313-333) as a
+    ppermute table."""
+    return [(i, i ^ (1 << bit)) for i in range(ndev)]
+
+
+def _shard_coeffs(rmat_like, mybit):
+    """Per-shard gate coefficients a = m[b,b], b_coef = m[b,1-b] selected by
+    the shard's target-bit value (statevec_compactUnitaryDistributed,
+    QuEST_cpu.c:1841-1900 uses rankIsUpper the same way)."""
+    row = mybit
+    a_re = rmat_like[0, row, row]
+    a_im = rmat_like[1, row, row]
+    b_re = rmat_like[0, row, 1 - row]
+    b_im = rmat_like[1, row, 1 - row]
+    return a_re, a_im, b_re, b_im
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "num_qubits", "target", "controls", "control_states"),
+    donate_argnums=0,
+)
+def apply_matrix_1q_sharded(
+    amps,
+    matrix,
+    *,
+    mesh: Mesh,
+    num_qubits: int,
+    target: int,
+    controls: Tuple[int, ...] = (),
+    control_states: Tuple[int, ...] = (),
+):
+    """One-qubit dense gate on a *sharded* target qubit: full-shard ppermute
+    exchange + fused elementwise combine — the reference's non-local gate
+    pattern (QuEST_cpu_distributed.c:854-928).
+
+    Low (local) controls restrict the exchanged+combined sub-block; sharded
+    controls become a per-shard mask (the reference instead skips ranks
+    whose chunk fails the control condition, :1093-1112 — SPMD cannot skip,
+    but masked shards do no extra communication since the exchange is
+    collective anyway)."""
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    n = num_qubits
+    nloc = n - r
+    assert target >= nloc, "local targets take the ordinary kernel"
+    bit = target - nloc
+    perm = _hypercube_perm(ndev, bit)
+
+    local_controls = tuple((c, s) for c, s in zip(controls, control_states or (1,) * len(controls)) if c < nloc)
+    shard_controls = tuple((c - nloc, s) for c, s in zip(controls, control_states or (1,) * len(controls)) if c >= nloc)
+
+    def kernel(local, m):
+        # local: (2, amps_per_shard); m: (2, 2, 2) stacked SoA
+        idx = lax.axis_index(AMP_AXIS)
+        mybit = (idx >> bit) & 1
+        recv = lax.ppermute(local, AMP_AXIS, perm)
+        a_re, a_im, b_re, b_im = _shard_coeffs(m, mybit)
+
+        def combine(own_block, recv_block):
+            return cplx.cmul(own_block, a_re, a_im) + cplx.cmul(recv_block, b_re, b_im)
+
+        if local_controls:
+            nl = nloc
+            sel = [slice(None)] * (nl + 1)
+            for c, s in local_controls:
+                sel[1 + (nl - 1 - c)] = int(s)
+            sel = tuple(sel)
+            lv = local.reshape((2,) + (2,) * nl)
+            rv = recv.reshape((2,) + (2,) * nl)
+            new = lv.at[sel].set(combine(lv[sel], rv[sel]))
+            new = new.reshape(2, -1)
+        else:
+            new = combine(local, recv)
+        for cbit, s in shard_controls:
+            cond = ((idx >> cbit) & 1) == s
+            new = jnp.where(cond, new, local)
+        return new
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(None, AMP_AXIS), P()),
+        out_specs=P(None, AMP_AXIS),
+    )(amps, jnp.asarray(matrix, amps.dtype))
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "qb_low", "qb_high"), donate_argnums=0)
+def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int, qb_high: int):
+    """SWAP between a local qubit and a sharded qubit: exchange only the
+    mismatched half-shard with the XOR partner (statevec_swapQubitAmps
+    routing, QuEST_cpu_distributed.c:1397-1436: 'pair processes only swap
+    half their amps').
+
+    Derivation: for shard-coordinate bit u (the high qubit's value) and
+    local bit v (the low qubit), elements with v == u stay; elements with
+    v != u land on the pair rank at local bit position unchanged-in-value.
+    So each shard sends its v = 1-u half and splices the received half back
+    at the same position."""
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = num_qubits - r
+    assert qb_high >= nloc and qb_low < nloc
+    bit = qb_high - nloc
+    perm = _hypercube_perm(ndev, bit)
+    ax = 1 + (nloc - 1 - qb_low)
+
+    def kernel(local):
+        idx = lax.axis_index(AMP_AXIS)
+        u = (idx >> bit) & 1
+        lv = local.reshape((2,) + (2,) * nloc)
+        # dynamic half-selection: take(lv, 1-u) along the low-qubit axis
+        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=ax, keepdims=False)
+        recv = lax.ppermute(send, AMP_AXIS, perm)
+        new = lax.dynamic_update_index_in_dim(
+            lv, recv, 1 - u, axis=ax
+        )
+        return new.reshape(2, -1)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(None, AMP_AXIS)
+    )(amps)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def total_prob_sharded(amps, *, mesh: Mesh):
+    """|amps|^2 with an explicit psum — the reference's local-reduce +
+    MPI_Allreduce(SUM) (QuEST_cpu_distributed.c:1308-1322)."""
+
+    def kernel(local):
+        return lax.psum(jnp.sum(cplx.abs2(local)), AMP_AXIS)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P()
+    )(amps)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def gather_replicated(amps, *, mesh: Mesh):
+    """Replicate the full state onto every device — the analogue of the
+    reference's ring-of-broadcasts copyVecIntoMatrixPairState
+    (QuEST_cpu_distributed.c:379-423), used to build rho = |psi><psi|."""
+
+    def kernel(local):
+        return lax.all_gather(local, AMP_AXIS, axis=1, tiled=True)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(),
+        check_vma=False,
+    )(amps)
+
+
+def plan_relocalization(
+    num_qubits: int,
+    nloc: int,
+    targets: Tuple[int, ...],
+    controls: Tuple[int, ...] = (),
+):
+    """Choose swap pairs pulling every sharded target down to a free local
+    qubit (reference picks the lowest free qubit and patches the control
+    mask on collision, QuEST_cpu_distributed.c:1508-1531; we instead exclude
+    controls from the free pool so the mask never needs patching).
+
+    Returns (swaps, new_targets), or (None, None) when there aren't enough
+    free local qubits — the caller falls back to the GSPMD path (the
+    reference instead *rejects* such ops via validateMultiQubitUnitaryMatrix,
+    QuEST_validation.c:469-471, so this is strictly more capable)."""
+    targets = list(targets)
+    blocked = set(targets) | set(controls)
+    free_local = [q for q in range(nloc) if q not in blocked]
+    swaps = []
+    for i, t in enumerate(targets):
+        if t >= nloc:
+            if not free_local:
+                return None, None
+            fq = free_local.pop(0)
+            swaps.append((fq, t))
+            targets[i] = fq
+    return tuple(swaps), tuple(targets)

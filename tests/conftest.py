@@ -10,9 +10,9 @@ double precision.
 
 import os
 
-# Force CPU even when the ambient environment pre-sets a TPU platform:
-# oracle comparisons run in f64, which TPUs don't support natively.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# CPU selection happens via jax.config.update below (the JAX_PLATFORMS env
+# var hangs backend init under the axon relay); the device-count flag must
+# still be set before jax initialises.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

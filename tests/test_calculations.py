@@ -1,0 +1,175 @@
+"""Calculation tests (analogue of reference test_calculations.cpp, 19
+TEST_CASEs): probabilities, inner products, purity, fidelity, expectation
+values."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+ATOL = 1e-10
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(21)
+
+
+def _rand_psi(env, rng):
+    vec = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    return q, vec
+
+
+def _rand_rho(env, rng):
+    mat = oracle.random_density(N, rng)
+    q = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, q, mat)
+    return q, mat
+
+
+def test_calc_total_prob(env, rng):
+    q, vec = _rand_psi(env, rng)
+    assert np.isclose(qt.calcTotalProb(q), 1.0)
+    r, mat = _rand_rho(env, rng)
+    assert np.isclose(qt.calcTotalProb(r), np.real(np.trace(mat)))
+
+
+@pytest.mark.parametrize("target", range(N))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_calc_prob_of_outcome(env, rng, target, outcome):
+    q, vec = _rand_psi(env, rng)
+    mask = ((np.arange(DIM) >> target) & 1) == outcome
+    expect = np.sum(np.abs(vec[mask]) ** 2)
+    assert np.isclose(qt.calcProbOfOutcome(q, target, outcome), expect)
+    r, mat = _rand_rho(env, rng)
+    expect_r = np.real(np.sum(np.diag(mat)[mask]))
+    assert np.isclose(qt.calcProbOfOutcome(r, target, outcome), expect_r)
+
+
+@pytest.mark.parametrize("qubits", [[0], [1, 3], [4, 0, 2], [0, 1, 2, 3, 4]])
+def test_calc_prob_of_all_outcomes(env, rng, qubits):
+    q, vec = _rand_psi(env, rng)
+    probs = np.abs(vec) ** 2
+    k = len(qubits)
+    expect = np.zeros(2 ** k)
+    for i in range(DIM):
+        out = sum(((i >> q) & 1) << j for j, q in enumerate(qubits))
+        expect[out] += probs[i]
+    np.testing.assert_allclose(qt.calcProbOfAllOutcomes(q, qubits), expect, atol=ATOL)
+    r, mat = _rand_rho(env, rng)
+    d = np.real(np.diag(mat))
+    expect_r = np.zeros(2 ** k)
+    for i in range(DIM):
+        out = sum(((i >> q) & 1) << j for j, q in enumerate(qubits))
+        expect_r[out] += d[i]
+    np.testing.assert_allclose(qt.calcProbOfAllOutcomes(r, qubits), expect_r, atol=ATOL)
+
+
+def test_calc_inner_product(env, rng):
+    q1, v1 = _rand_psi(env, rng)
+    q2, v2 = _rand_psi(env, rng)
+    expect = np.vdot(v1, v2)
+    got = qt.calcInnerProduct(q1, q2)
+    assert np.isclose(got, expect)
+
+
+def test_calc_density_inner_product(env, rng):
+    r1, m1 = _rand_rho(env, rng)
+    r2, m2 = _rand_rho(env, rng)
+    expect = np.real(np.trace(m1.conj().T @ m2))
+    assert np.isclose(qt.calcDensityInnerProduct(r1, r2), expect)
+
+
+def test_calc_purity(env, rng):
+    r, mat = _rand_rho(env, rng)
+    expect = np.real(np.trace(mat @ mat))
+    assert np.isclose(qt.calcPurity(r), expect)
+
+
+def test_calc_fidelity(env, rng):
+    q1, v1 = _rand_psi(env, rng)
+    q2, v2 = _rand_psi(env, rng)
+    assert np.isclose(qt.calcFidelity(q1, q2), np.abs(np.vdot(v1, v2)) ** 2)
+    r, mat = _rand_rho(env, rng)
+    expect = np.real(np.vdot(v1, mat @ v1))
+    assert np.isclose(qt.calcFidelity(r, q1), expect)
+
+
+def test_calc_hilbert_schmidt_distance(env, rng):
+    r1, m1 = _rand_rho(env, rng)
+    r2, m2 = _rand_rho(env, rng)
+    expect = np.sqrt(np.sum(np.abs(m1 - m2) ** 2))
+    assert np.isclose(qt.calcHilbertSchmidtDistance(r1, r2), expect)
+
+
+@pytest.mark.parametrize(
+    "targets,codes",
+    [([0], [3]), ([2], [1]), ([1, 4], [2, 3]), ([0, 2, 3], [1, 1, 2])],
+)
+def test_calc_expec_pauli_prod(env, rng, targets, codes):
+    q, vec = _rand_psi(env, rng)
+    op = oracle.pauli_product(N, targets, codes)
+    expect = np.real(np.vdot(vec, op @ vec))
+    assert np.isclose(qt.calcExpecPauliProd(q, targets, codes), expect)
+    r, mat = _rand_rho(env, rng)
+    expect_r = np.real(np.trace(op @ mat))
+    assert np.isclose(qt.calcExpecPauliProd(r, targets, codes), expect_r)
+
+
+def test_calc_expec_pauli_sum_and_hamil(env, rng):
+    num_terms = 4
+    codes = rng.integers(0, 4, size=(num_terms, N))
+    coeffs = rng.standard_normal(num_terms)
+    q, vec = _rand_psi(env, rng)
+    hmat = oracle.pauli_sum_matrix(N, codes, coeffs)
+    expect = np.real(np.vdot(vec, hmat @ vec))
+    assert np.isclose(qt.calcExpecPauliSum(q, codes, coeffs), expect)
+
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes)
+    assert np.isclose(qt.calcExpecPauliHamil(q, hamil), expect)
+
+    r, mat = _rand_rho(env, rng)
+    expect_r = np.real(np.trace(hmat @ mat))
+    assert np.isclose(qt.calcExpecPauliHamil(r, hamil), expect_r)
+
+
+def test_calc_expec_diagonal_op(env, rng):
+    op = qt.createDiagonalOp(N, env)
+    vals = rng.standard_normal(DIM) + 1j * rng.standard_normal(DIM)
+    qt.initDiagonalOp(op, vals.real, vals.imag)
+    q, vec = _rand_psi(env, rng)
+    expect = np.sum(np.abs(vec) ** 2 * vals)
+    assert np.isclose(qt.calcExpecDiagonalOp(q, op), expect)
+    r, mat = _rand_rho(env, rng)
+    expect_r = np.sum(np.diag(mat) * vals)
+    assert np.isclose(qt.calcExpecDiagonalOp(r, op), expect_r)
+
+
+def test_get_amp_family(env, rng):
+    q, vec = _rand_psi(env, rng)
+    assert np.isclose(qt.getAmp(q, 7), vec[7])
+    assert np.isclose(qt.getRealAmp(q, 3), vec[3].real)
+    assert np.isclose(qt.getImagAmp(q, 3), vec[3].imag)
+    assert np.isclose(qt.getProbAmp(q, 5), np.abs(vec[5]) ** 2)
+    r, mat = _rand_rho(env, rng)
+    assert np.isclose(qt.getDensityAmp(r, 2, 3), mat[2, 3])
+
+
+def test_calc_validation(env):
+    q = qt.createQureg(N, env)
+    r = qt.createDensityQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.calcInnerProduct(q, r)
+    with pytest.raises(qt.QuESTError, match="density matri"):
+        qt.calcPurity(q)
+    with pytest.raises(qt.QuESTError, match="density matri"):
+        qt.calcDensityInnerProduct(q, q)
+    q3 = qt.createQureg(3, env)
+    with pytest.raises(qt.QuESTError, match="Dimensions"):
+        qt.calcFidelity(q, q3)

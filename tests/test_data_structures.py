@@ -1,0 +1,174 @@
+"""Data-structure tests (analogue of reference test_data_structures.cpp, 23
+TEST_CASEs): registers, matrices, PauliHamil (incl. file IO), DiagonalOp,
+QASM logging."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+
+
+def test_create_qureg_metadata(env):
+    q = qt.createQureg(N, env)
+    assert qt.getNumQubits(q) == N
+    assert qt.getNumAmps(q) == DIM
+    assert q.num_chunks == env.num_devices
+    assert q.num_amps_per_chunk * q.num_chunks == DIM
+    r = qt.createDensityQureg(N, env)
+    assert r.num_amps_total == DIM * DIM
+    assert r.num_qubits_in_state_vec == 2 * N
+    with pytest.raises(qt.QuESTError):
+        qt.createQureg(0, env)
+    with pytest.raises(qt.QuESTError):
+        qt.createQureg(-3, env)
+
+
+def test_complex_matrix_n(env):
+    m = qt.createComplexMatrixN(3)
+    assert m.shape == (8, 8)
+    reals = np.arange(64).reshape(8, 8)
+    imags = -np.arange(64).reshape(8, 8)
+    qt.initComplexMatrixN(m, reals, imags)
+    assert m[1, 2] == 10 - 10j
+    m2 = qt.getStaticComplexMatrixN([[1, 0], [0, 1]], [[0, 0], [0, 0]])
+    np.testing.assert_array_equal(m2, np.eye(2))
+
+
+def test_pauli_hamil_create_init(env):
+    h = qt.createPauliHamil(N, 3)
+    assert h.num_qubits == N and h.num_sum_terms == 3
+    assert np.all(h.pauli_codes == 0)  # identity-initialised (QuEST.c:1394)
+    coeffs = [0.5, -1.0, 2.0]
+    codes = np.array([[1, 0, 0, 0, 0], [0, 2, 0, 3, 0], [3, 3, 3, 3, 3]])
+    qt.initPauliHamil(h, coeffs, codes)
+    np.testing.assert_array_equal(h.pauli_codes, codes)
+    with pytest.raises(qt.QuESTError):
+        qt.createPauliHamil(0, 1)
+    with pytest.raises(qt.QuESTError):
+        qt.initPauliHamil(h, coeffs, np.full((3, N), 7))
+
+
+def test_pauli_hamil_from_file(env):
+    content = "0.5 1 0 2\n-1.5 3 3 0\n2.0 0 0 0\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        h = qt.createPauliHamilFromFile(path)
+        assert h.num_qubits == 3
+        assert h.num_sum_terms == 3
+        np.testing.assert_allclose(h.term_coeffs, [0.5, -1.5, 2.0])
+        np.testing.assert_array_equal(
+            h.pauli_codes, [[1, 0, 2], [3, 3, 0], [0, 0, 0]]
+        )
+    finally:
+        os.unlink(path)
+    with pytest.raises(qt.QuESTError, match="file"):
+        qt.createPauliHamilFromFile("/nonexistent/file.txt")
+
+
+def test_diagonal_op(env):
+    op = qt.createDiagonalOp(N, env)
+    assert op.num_qubits == N
+    vals_re = np.arange(DIM, dtype=float)
+    vals_im = -np.arange(DIM, dtype=float)
+    qt.initDiagonalOp(op, vals_re, vals_im)
+    qt.syncDiagonalOp(op)  # no-op, must not raise
+    np.testing.assert_allclose(np.asarray(op.real), vals_re)
+    qt.setDiagonalOpElems(op, 4, [100.0, 200.0], [0.0, 0.0], 2)
+    assert float(np.asarray(op.real)[4]) == 100.0
+    assert float(np.asarray(op.real)[6]) == 6.0
+    with pytest.raises(qt.QuESTError):
+        qt.setDiagonalOpElems(op, DIM - 1, [1.0, 2.0], [0.0, 0.0], 2)
+
+
+def test_diagonal_op_from_pauli_hamil(env):
+    h = qt.createPauliHamil(3, 2)
+    qt.initPauliHamil(h, [1.0, 0.5], np.array([[3, 0, 0], [0, 3, 3]]))
+    op = qt.createDiagonalOp(3, env)
+    qt.initDiagonalOpFromPauliHamil(op, h)
+    # d_i = 1.0*(-1)^{b0} + 0.5*(-1)^{b1+b2}
+    idx = np.arange(8)
+    expect = 1.0 * (1 - 2.0 * (idx & 1)) + 0.5 * (
+        (1 - 2.0 * ((idx >> 1) & 1)) * (1 - 2.0 * ((idx >> 2) & 1))
+    )
+    np.testing.assert_allclose(np.asarray(op.real), expect)
+    # X/Y codes are rejected
+    h2 = qt.createPauliHamil(3, 1)
+    qt.initPauliHamil(h2, [1.0], np.array([[1, 0, 0]]))
+    with pytest.raises(qt.QuESTError, match="PAULI_Z"):
+        qt.initDiagonalOpFromPauliHamil(op, h2)
+
+
+def test_diagonal_op_from_file(env):
+    content = "1.0 3 0\n0.5 0 3\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        op = qt.createDiagonalOpFromPauliHamilFile(path, env)
+        idx = np.arange(4)
+        expect = 1.0 * (1 - 2.0 * (idx & 1)) + 0.5 * (1 - 2.0 * ((idx >> 1) & 1))
+        np.testing.assert_allclose(np.asarray(op.real), expect)
+    finally:
+        os.unlink(path)
+
+
+def test_qasm_recording(env):
+    q = qt.createQureg(3, env)
+    qt.startRecordingQASM(q)
+    qt.hadamard(q, 0)
+    qt.controlledNot(q, 0, 1)
+    qt.rotateX(q, 2, 0.5)
+    qt.tGate(q, 0)
+    qt.measure(q, 1)
+    qt.stopRecordingQASM(q)
+    qt.pauliX(q, 0)  # after stop: not recorded
+    text = str(q.qasm_log)
+    assert "OPENQASM 2.0;" in text
+    assert "h q[0];" in text
+    assert "cx q[0],q[1];" in text
+    assert "Rx(0.5) q[2];" in text
+    assert "t q[0];" in text
+    assert "measure q[1] -> c[1];" in text
+    assert text.count("x q[0];") == 0
+    with tempfile.NamedTemporaryFile("r", suffix=".qasm", delete=False) as f:
+        path = f.name
+    try:
+        qt.writeRecordedQASMToFile(q, path)
+        assert open(path).read() == text
+    finally:
+        os.unlink(path)
+    qt.clearRecordedQASM(q)
+    assert "h q[0];" not in str(q.qasm_log)
+
+
+def test_qasm_control_state_sandwich(env):
+    q = qt.createQureg(3, env)
+    qt.startRecordingQASM(q)
+    u = np.eye(2)
+    qt.multiStateControlledUnitary(q, [0, 1], [0, 1], 2, u)
+    text = str(q.qasm_log)
+    # control-on-zero wrapped in an X sandwich (QuEST_qasm.c:363-380)
+    assert text.count("x q[0];") == 2
+
+
+def test_environment_reporting(env, capsys):
+    qt.reportQuESTEnv(env)
+    out = capsys.readouterr().out
+    assert "quest_tpu" in out
+    s = qt.getEnvironmentString(env)
+    assert "Devices" in s
+    qt.syncQuESTEnv(env)
+    assert qt.syncQuESTSuccess(1) == 1
+    q = qt.createQureg(2, env)
+    qt.reportQuregParams(q)
+    out = capsys.readouterr().out
+    assert "4" in out

@@ -1,0 +1,157 @@
+"""Decoherence channel tests (analogue of reference test_decoherence.cpp,
+10 TEST_CASEs), all against the dense Kraus oracle on random mixed states."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+ATOL = 1e-10
+
+
+@pytest.fixture
+def rho_pair(env):
+    rng = np.random.default_rng(55)
+    mat = oracle.random_density(N, rng)
+    r = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    return r, mat
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_dephasing(env, rho_pair, target):
+    r, mat = rho_pair
+    p = 0.3
+    qt.mixDephasing(r, target, p)
+    Z = oracle.full_operator(N, [target], oracle.Z)
+    expect = (1 - p) * mat + p * Z @ mat @ Z
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (3, 1), (2, 4)])
+def test_mix_two_qubit_dephasing(env, rho_pair, q1, q2):
+    r, mat = rho_pair
+    p = 0.5
+    qt.mixTwoQubitDephasing(r, q1, q2, p)
+    Z1 = oracle.full_operator(N, [q1], oracle.Z)
+    Z2 = oracle.full_operator(N, [q2], oracle.Z)
+    expect = (1 - p) * mat + (p / 3) * (
+        Z1 @ mat @ Z1 + Z2 @ mat @ Z2 + Z1 @ Z2 @ mat @ Z2 @ Z1
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_depolarising(env, rho_pair, target):
+    r, mat = rho_pair
+    p = 0.6
+    qt.mixDepolarising(r, target, p)
+    X = oracle.full_operator(N, [target], oracle.X)
+    Y = oracle.full_operator(N, [target], oracle.Y)
+    Z = oracle.full_operator(N, [target], oracle.Z)
+    expect = (1 - p) * mat + (p / 3) * (X @ mat @ X + Y @ mat @ Y + Z @ mat @ Z)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("target", [0, 2, 4])
+def test_mix_damping(env, rho_pair, target):
+    r, mat = rho_pair
+    p = 0.35
+    qt.mixDamping(r, target, p)
+    k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]])
+    k1 = np.array([[0, np.sqrt(p)], [0, 0]])
+    expect = oracle.apply_kraus_to_density(mat, N, [target], [k0, k1])
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (4, 2)])
+def test_mix_two_qubit_depolarising(env, rho_pair, q1, q2):
+    r, mat = rho_pair
+    p = 0.7
+    qt.mixTwoQubitDepolarising(r, q1, q2, p)
+    expect = (1 - p) * mat
+    for i in range(4):
+        for j in range(4):
+            if i == 0 and j == 0:
+                continue
+            P1 = oracle.full_operator(N, [q1], oracle.PAULIS[i])
+            P2 = oracle.full_operator(N, [q2], oracle.PAULIS[j])
+            expect = expect + (p / 15) * (P1 @ P2 @ mat @ P2 @ P1)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+def test_mix_pauli(env, rho_pair):
+    r, mat = rho_pair
+    px, py, pz = 0.1, 0.15, 0.2
+    target = 3
+    qt.mixPauli(r, target, px, py, pz)
+    X = oracle.full_operator(N, [target], oracle.X)
+    Y = oracle.full_operator(N, [target], oracle.Y)
+    Z = oracle.full_operator(N, [target], oracle.Z)
+    expect = (
+        (1 - px - py - pz) * mat + px * X @ mat @ X + py * Y @ mat @ Y + pz * Z @ mat @ Z
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+def test_mix_density_matrix(env):
+    rng = np.random.default_rng(66)
+    m1, m2 = oracle.random_density(N, rng), oracle.random_density(N, rng)
+    r1 = qt.createDensityQureg(N, env)
+    r2 = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, r1, m1)
+    oracle.set_qureg_from_array(qt, r2, m2)
+    qt.mixDensityMatrix(r1, 0.4, r2)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(r1), 0.6 * m1 + 0.4 * m2, atol=ATOL
+    )
+
+
+@pytest.mark.parametrize("num_ops", [1, 2, 4])
+def test_mix_kraus_map(env, rho_pair, num_ops):
+    r, mat = rho_pair
+    rng = np.random.default_rng(77)
+    ops = oracle.random_kraus_map(1, num_ops, rng)
+    qt.mixKrausMap(r, 2, ops)
+    expect = oracle.apply_kraus_to_density(mat, N, [2], ops)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("targets,num_ops", [((0, 1), 2), ((3, 1), 4)])
+def test_mix_two_qubit_kraus_map(env, rho_pair, targets, num_ops):
+    r, mat = rho_pair
+    rng = np.random.default_rng(88)
+    ops = oracle.random_kraus_map(2, num_ops, rng)
+    qt.mixTwoQubitKrausMap(r, targets[0], targets[1], ops)
+    expect = oracle.apply_kraus_to_density(mat, N, list(targets), ops)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("targets,num_ops", [((2,), 2), ((0, 3), 3), ((1, 2, 4), 2)])
+def test_mix_multi_qubit_kraus_map(env, rho_pair, targets, num_ops):
+    r, mat = rho_pair
+    rng = np.random.default_rng(99)
+    ops = oracle.random_kraus_map(len(targets), num_ops, rng)
+    qt.mixMultiQubitKrausMap(r, list(targets), ops)
+    expect = oracle.apply_kraus_to_density(mat, N, list(targets), ops)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+def test_decoherence_validation(env):
+    r = qt.createDensityQureg(N, env)
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="density matri"):
+        qt.mixDephasing(q, 0, 0.1)
+    with pytest.raises(qt.QuESTError, match="probability"):
+        qt.mixDephasing(r, 0, 0.6)  # > 1/2
+    with pytest.raises(qt.QuESTError, match="probability"):
+        qt.mixDepolarising(r, 0, 0.8)  # > 3/4
+    with pytest.raises(qt.QuESTError, match="probability"):
+        qt.mixDamping(r, 0, 1.2)
+    with pytest.raises(qt.QuESTError, match="CPTP"):
+        qt.mixKrausMap(r, 0, [np.eye(2) * 2])
+    with pytest.raises(qt.QuESTError, match="sum"):
+        qt.mixPauli(r, 0, 0.5, 0.4, 0.3)

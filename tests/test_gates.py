@@ -1,0 +1,100 @@
+"""Measurement / collapse tests (analogue of reference test_gates.cpp, 3
+TEST_CASEs: collapseToOutcome, measure, measureWithStats — statistical ops
+tested by repeats on random states, asserting the post-collapse state equals
+the analytically renormalised reference, test_gates.cpp:121-160)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+ATOL = 1e-10
+
+
+def _collapsed(vec, target, outcome):
+    mask = ((np.arange(DIM) >> target) & 1) == outcome
+    prob = np.sum(np.abs(vec[mask]) ** 2)
+    out = np.where(mask, vec, 0)
+    return out / np.sqrt(prob), prob
+
+
+@pytest.mark.parametrize("target", range(N))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_collapse_to_outcome_statevec(env, target, outcome):
+    rng = np.random.default_rng(31)
+    vec = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    prob = qt.collapseToOutcome(q, target, outcome)
+    expect, eprob = _collapsed(vec, target, outcome)
+    assert np.isclose(prob, eprob)
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("target", [0, 2, 4])
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_collapse_to_outcome_density(env, target, outcome):
+    rng = np.random.default_rng(32)
+    mat = oracle.random_density(N, rng)
+    r = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    prob = qt.collapseToOutcome(r, target, outcome)
+    mask = ((np.arange(DIM) >> target) & 1) == outcome
+    proj = np.diag(mask.astype(float))
+    expect_m = proj @ mat @ proj
+    eprob = np.real(np.trace(expect_m))
+    expect_m = expect_m / eprob
+    assert np.isclose(prob, eprob)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect_m, atol=ATOL)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_measure_repeats(env, target):
+    """10 repeats per qubit on random states (reference pattern)."""
+    rng = np.random.default_rng(33 + target)
+    for rep in range(10):
+        vec = oracle.random_state(N, rng)
+        q = qt.createQureg(N, env)
+        oracle.set_qureg_from_array(qt, q, vec)
+        outcome, prob = qt.measureWithStats(q, target)
+        assert outcome in (0, 1)
+        expect, eprob = _collapsed(vec, target, outcome)
+        assert np.isclose(prob, eprob)
+        np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+        # post-measurement probability of that outcome is now 1
+        assert np.isclose(qt.calcProbOfOutcome(q, target, outcome), 1.0)
+
+
+def test_measure_density(env):
+    rng = np.random.default_rng(44)
+    mat = oracle.random_density(N, rng)
+    r = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    outcome, prob = qt.measureWithStats(r, 2)
+    assert np.isclose(qt.calcProbOfOutcome(r, 2, outcome), 1.0)
+    assert np.isclose(qt.calcTotalProb(r), 1.0)
+
+
+def test_measure_statistics(env):
+    """Outcome frequencies follow the amplitudes (|psi> = sqrt(0.2)|0> +
+    sqrt(0.8)|1>)."""
+    qt.seedQuEST(env, [99])
+    hits = 0
+    trials = 400
+    for _ in range(trials):
+        q = qt.createQureg(1, env)
+        qt.initStateFromAmps(q, [np.sqrt(0.2), np.sqrt(0.8)], [0, 0])
+        hits += qt.measure(q, 0)
+    freq = hits / trials
+    assert abs(freq - 0.8) < 0.07
+
+
+def test_gate_validation(env):
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="outcome"):
+        qt.collapseToOutcome(q, 0, 2)
+    with pytest.raises(qt.QuESTError, match="zero probability"):
+        qt.collapseToOutcome(q, 0, 1)  # |0...0> has no 1-amplitude

@@ -1,0 +1,316 @@
+"""Operator tests (analogue of reference test_operators.cpp, 18 TEST_CASEs):
+the apply* family — matrices, Pauli sums, Trotter circuits, diagonal ops,
+phase functions, QFT."""
+
+import numpy as np
+import pytest
+from scipy.linalg import expm
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+ATOL = 1e-10
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(123)
+
+
+def _rand_psi(env, rng):
+    vec = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    return q, vec
+
+
+def _rand_rho(env, rng):
+    mat = oracle.random_density(N, rng)
+    q = qt.createDensityQureg(N, env)
+    oracle.set_qureg_from_array(qt, q, mat)
+    return q, mat
+
+
+def test_set_weighted_qureg(env, rng):
+    v1, v2, v3 = (oracle.random_state(N, rng) for _ in range(3))
+    q1 = qt.createQureg(N, env)
+    q2 = qt.createQureg(N, env)
+    out = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, q1, v1)
+    oracle.set_qureg_from_array(qt, q2, v2)
+    oracle.set_qureg_from_array(qt, out, v3)
+    f1, f2, fo = 0.3 - 0.1j, -1.2j, 0.5 + 0.2j
+    qt.setWeightedQureg(f1, q1, f2, q2, fo, out)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(out), f1 * v1 + f2 * v2 + fo * v3, atol=ATOL
+    )
+
+
+def test_apply_matrix2_not_unitary_no_twin(env, rng):
+    """apply* family: arbitrary matrix, left-multiply only (no rho twin)."""
+    m = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    q, vec = _rand_psi(env, rng)
+    qt.applyMatrix2(q, 2, m)
+    expect = oracle.full_operator(N, [2], m) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+    # density: M . rho, NOT M rho M^dag (SURVEY.md §2.3 semantic trap)
+    r, mat = _rand_rho(env, rng)
+    qt.applyMatrix2(r, 2, m)
+    expect_r = oracle.full_operator(N, [2], m) @ mat
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect_r, atol=ATOL)
+
+
+def test_apply_matrix4(env, rng):
+    m = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    q, vec = _rand_psi(env, rng)
+    qt.applyMatrix4(q, 1, 3, m)
+    expect = oracle.full_operator(N, [1, 3], m) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("targets", [[0], [2, 4], [1, 0, 3]])
+def test_apply_matrix_n(env, rng, targets):
+    dim = 1 << len(targets)
+    m = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+    q, vec = _rand_psi(env, rng)
+    qt.applyMatrixN(q, targets, m)
+    expect = oracle.full_operator(N, targets, m) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_multi_controlled_matrix_n(env, rng):
+    m = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    q, vec = _rand_psi(env, rng)
+    qt.applyMultiControlledMatrixN(q, [0, 4], [2], m)
+    expect = oracle.controlled_operator(N, [0, 4], [2], m) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_pauli_sum(env, rng):
+    num_terms = 3
+    codes = rng.integers(0, 4, size=(num_terms, N))
+    coeffs = rng.standard_normal(num_terms)
+    q, vec = _rand_psi(env, rng)
+    out = qt.createQureg(N, env)
+    qt.applyPauliSum(q, codes, coeffs, out)
+    expect = oracle.pauli_sum_matrix(N, codes, coeffs) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(out), expect, atol=ATOL)
+    # input register untouched
+    np.testing.assert_allclose(oracle.state_from_qureg(q), vec, atol=ATOL)
+
+
+def test_apply_pauli_hamil(env, rng):
+    num_terms = 4
+    codes = rng.integers(0, 4, size=(num_terms, N))
+    coeffs = rng.standard_normal(num_terms)
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes)
+    q, vec = _rand_psi(env, rng)
+    out = qt.createQureg(N, env)
+    qt.applyPauliHamil(q, hamil, out)
+    expect = oracle.pauli_sum_matrix(N, codes, coeffs) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(out), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize("order,reps,tol", [(1, 30, 2e-2), (2, 10, 1e-3), (4, 3, 1e-4)])
+def test_apply_trotter_circuit(env, rng, order, reps, tol):
+    """e^{-iHt} approximation converging with order/reps (reference
+    test_operators.cpp applyTrotterCircuit)."""
+    num_terms = 3
+    codes = rng.integers(0, 4, size=(num_terms, N))
+    coeffs = rng.standard_normal(num_terms) * 0.5
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes)
+    t = 0.7
+    q, vec = _rand_psi(env, rng)
+    qt.applyTrotterCircuit(q, hamil, t, order, reps)
+    hmat = oracle.pauli_sum_matrix(N, codes, coeffs)
+    expect = expm(-1j * hmat * t) @ vec
+    got = oracle.state_from_qureg(q)
+    # compare up to nothing: Trotter is exact in the limit; tolerance scales
+    assert np.max(np.abs(got - expect)) < tol
+
+
+def test_apply_diagonal_op(env, rng):
+    op = qt.createDiagonalOp(N, env)
+    vals = rng.standard_normal(DIM) + 1j * rng.standard_normal(DIM)
+    qt.initDiagonalOp(op, vals.real, vals.imag)
+    q, vec = _rand_psi(env, rng)
+    qt.applyDiagonalOp(q, op)
+    np.testing.assert_allclose(oracle.state_from_qureg(q), vals * vec, atol=ATOL)
+    # density: left-multiply D.rho
+    r, mat = _rand_rho(env, rng)
+    qt.applyDiagonalOp(r, op)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(r), np.diag(vals) @ mat, atol=ATOL
+    )
+
+
+# ---------------------------------------------------------------------------
+# Phase functions
+# ---------------------------------------------------------------------------
+
+
+def _phase_expect(vec, reg_qubits, encoding, phase_fn, overrides=None):
+    """Oracle: multiply amp_i by exp(i theta(x1..xm)) decoding sub-registers
+    from index bits."""
+    out = np.empty_like(vec)
+    for i in range(DIM):
+        xs = []
+        for qs in reg_qubits:
+            v = sum(((i >> q) & 1) << j for j, q in enumerate(qs))
+            if encoding == qt.TWOS_COMPLEMENT and v >= (1 << (len(qs) - 1)):
+                v -= 1 << len(qs)
+            xs.append(v)
+        theta = None
+        if overrides:
+            for inds, ph in overrides:
+                if tuple(xs) == tuple(inds):
+                    theta = ph
+                    break
+        if theta is None:
+            theta = phase_fn(xs)
+        out[i] = vec[i] * np.exp(1j * theta)
+    return out
+
+
+def test_apply_phase_func_polynomial(env, rng):
+    q, vec = _rand_psi(env, rng)
+    qubits = [0, 2, 3]
+    coeffs = [0.5, -1.2]
+    expos = [1.0, 2.0]
+    qt.applyPhaseFunc(q, qubits, qt.UNSIGNED, coeffs, expos)
+    expect = _phase_expect(
+        vec, [qubits], qt.UNSIGNED,
+        lambda xs: sum(c * xs[0] ** e for c, e in zip(coeffs, expos)),
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_phase_func_twos_complement_with_overrides(env, rng):
+    q, vec = _rand_psi(env, rng)
+    qubits = [1, 4, 0]
+    coeffs = [0.8]
+    expos = [3.0]
+    overrides = [((-4,), 0.123), ((1,), -2.5)]
+    qt.applyPhaseFuncOverrides(
+        q, qubits, qt.TWOS_COMPLEMENT, coeffs, expos,
+        [o[0][0] for o in overrides], [o[1] for o in overrides],
+    )
+    expect = _phase_expect(
+        vec, [qubits], qt.TWOS_COMPLEMENT,
+        lambda xs: coeffs[0] * float(xs[0]) ** expos[0],
+        overrides,
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_multi_var_phase_func(env, rng):
+    q, vec = _rand_psi(env, rng)
+    regs = [[0, 1], [2, 3, 4]]
+    terms_per_reg = [2, 1]
+    coeffs = [1.0, 0.5, -0.3]
+    expos = [1.0, 2.0, 1.0]
+    qt.applyMultiVarPhaseFunc(q, [0, 1, 2, 3, 4], [2, 3], qt.UNSIGNED, coeffs, expos, terms_per_reg)
+    expect = _phase_expect(
+        vec, regs, qt.UNSIGNED,
+        lambda xs: 1.0 * xs[0] + 0.5 * xs[0] ** 2 - 0.3 * xs[1],
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+@pytest.mark.parametrize(
+    "func,params,phase_fn",
+    [
+        (qt.NORM, None, lambda xs: np.sqrt(sum(x * x for x in xs))),
+        (qt.SCALED_NORM, [2.5], lambda xs: 2.5 * np.sqrt(sum(x * x for x in xs))),
+        (
+            qt.INVERSE_NORM,
+            [7.0],
+            lambda xs: 7.0 if sum(x * x for x in xs) == 0 else 1 / np.sqrt(sum(x * x for x in xs)),
+        ),
+        (qt.PRODUCT, None, lambda xs: float(np.prod(xs))),
+        (
+            qt.SCALED_INVERSE_PRODUCT,
+            [3.0, 9.0],
+            lambda xs: 9.0 if np.prod(xs) == 0 else 3.0 / float(np.prod(xs)),
+        ),
+        (qt.DISTANCE, None, lambda xs: np.sqrt((xs[1] - xs[0]) ** 2)),
+        (
+            qt.SCALED_INVERSE_SHIFTED_NORM,
+            [0.5, 4.0, 1.0, -1.0],
+            lambda xs: 4.0
+            if (xs[0] - 1.0) ** 2 + (xs[1] + 1.0) ** 2 == 0
+            else 0.5 / np.sqrt((xs[0] - 1.0) ** 2 + (xs[1] + 1.0) ** 2),
+        ),
+    ],
+)
+def test_apply_named_phase_func(env, rng, func, params, phase_fn):
+    q, vec = _rand_psi(env, rng)
+    regs = [[0, 3], [1, 4]]
+    if params is None:
+        qt.applyNamedPhaseFunc(q, [0, 3, 1, 4], [2, 2], qt.UNSIGNED, func)
+    else:
+        qt.applyParamNamedPhaseFunc(q, [0, 3, 1, 4], [2, 2], qt.UNSIGNED, func, params)
+    expect = _phase_expect(vec, regs, qt.UNSIGNED, phase_fn)
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_named_phase_func_overrides(env, rng):
+    q, vec = _rand_psi(env, rng)
+    regs = [[0, 1, 2], [3, 4]]
+    overrides = [((0, 0), 0.77), ((5, 2), -0.3)]
+    qt.applyNamedPhaseFuncOverrides(
+        q, [0, 1, 2, 3, 4], [3, 2], qt.UNSIGNED, qt.NORM,
+        [i for o in overrides for i in o[0]], [o[1] for o in overrides],
+    )
+    expect = _phase_expect(
+        vec, regs, qt.UNSIGNED,
+        lambda xs: np.sqrt(sum(x * x for x in xs)), overrides,
+    )
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# QFT
+# ---------------------------------------------------------------------------
+
+
+def test_apply_full_qft(env, rng):
+    q, vec = _rand_psi(env, rng)
+    qt.applyFullQFT(q)
+    expect = oracle.dft_matrix(N) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_apply_full_qft_density(env, rng):
+    r, mat = _rand_rho(env, rng)
+    qt.applyFullQFT(r)
+    F = oracle.dft_matrix(N)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(r), F @ mat @ F.conj().T, atol=ATOL
+    )
+
+
+@pytest.mark.parametrize("qubits", [[0], [1, 3], [4, 2, 0]])
+def test_apply_qft_subset(env, rng, qubits):
+    """applyQFT on a qubit subset == full operator built from the DFT on
+    those qubits (qubits[0] = least significant)."""
+    q, vec = _rand_psi(env, rng)
+    qt.applyQFT(q, qubits)
+    sub_dft = oracle.dft_matrix(len(qubits))
+    expect = oracle.full_operator(N, qubits, sub_dft) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+
+
+def test_operator_validation(env, rng):
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="size"):
+        qt.applyMatrix2(q, 0, np.eye(4))
+    with pytest.raises(qt.QuESTError, match="Trotter order"):
+        hamil = qt.createPauliHamil(N, 1)
+        qt.applyTrotterCircuit(q, hamil, 0.1, 3, 1)
+    with pytest.raises(qt.QuESTError, match="encoding"):
+        qt.applyPhaseFunc(q, [0, 1], 5, [1.0], [1.0])

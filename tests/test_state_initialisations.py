@@ -1,0 +1,129 @@
+"""State initialisation tests (analogue of reference
+test_state_initialisations.cpp, 9 TEST_CASEs)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+
+N = 5
+DIM = 1 << N
+ATOL = 1e-12
+
+
+def test_init_blank_state(env):
+    q = qt.createQureg(N, env)
+    qt.initBlankState(q)
+    np.testing.assert_allclose(oracle.state_from_qureg(q), np.zeros(DIM), atol=ATOL)
+    r = qt.createDensityQureg(N, env)
+    qt.initBlankState(r)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), np.zeros((DIM, DIM)), atol=ATOL)
+
+
+def test_init_zero_state(env):
+    q = qt.createQureg(N, env)
+    qt.initDebugState(q)
+    qt.initZeroState(q)
+    expect = np.zeros(DIM, complex)
+    expect[0] = 1
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+    r = qt.createDensityQureg(N, env)
+    qt.initZeroState(r)
+    em = np.zeros((DIM, DIM), complex)
+    em[0, 0] = 1
+    np.testing.assert_allclose(oracle.state_from_qureg(r), em, atol=ATOL)
+
+
+def test_init_plus_state(env):
+    q = qt.createQureg(N, env)
+    qt.initPlusState(q)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(q), np.full(DIM, 1 / np.sqrt(DIM)), atol=ATOL
+    )
+    r = qt.createDensityQureg(N, env)
+    qt.initPlusState(r)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(r), np.full((DIM, DIM), 1 / DIM), atol=ATOL
+    )
+
+
+@pytest.mark.parametrize("ind", [0, 1, 13, DIM - 1])
+def test_init_classical_state(env, ind):
+    q = qt.createQureg(N, env)
+    qt.initClassicalState(q, ind)
+    expect = np.zeros(DIM, complex)
+    expect[ind] = 1
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect, atol=ATOL)
+    r = qt.createDensityQureg(N, env)
+    qt.initClassicalState(r, ind)
+    em = np.zeros((DIM, DIM), complex)
+    em[ind, ind] = 1
+    np.testing.assert_allclose(oracle.state_from_qureg(r), em, atol=ATOL)
+
+
+def test_init_pure_state(env):
+    rng = np.random.default_rng(7)
+    vec = oracle.random_state(N, rng)
+    src = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, src, vec)
+    # statevec <- statevec copy
+    dst = qt.createQureg(N, env)
+    qt.initPureState(dst, src)
+    np.testing.assert_allclose(oracle.state_from_qureg(dst), vec, atol=ATOL)
+    # rho <- |psi><psi|
+    rho = qt.createDensityQureg(N, env)
+    qt.initPureState(rho, src)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(rho), np.outer(vec, vec.conj()), atol=ATOL
+    )
+
+
+def test_init_debug_state(env):
+    q = qt.createQureg(N, env)
+    qt.initDebugState(q)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(q), oracle.debug_state(DIM), atol=ATOL
+    )
+
+
+def test_init_state_from_amps_and_set_amps(env):
+    rng = np.random.default_rng(8)
+    vec = oracle.random_state(N, rng)
+    q = qt.createQureg(N, env)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    np.testing.assert_allclose(oracle.state_from_qureg(q), vec, atol=ATOL)
+    # partial overwrite
+    sub = rng.standard_normal(4) + 1j * rng.standard_normal(4)
+    qt.setAmps(q, 3, sub.real, sub.imag, 4)
+    vec2 = vec.copy()
+    vec2[3:7] = sub
+    np.testing.assert_allclose(oracle.state_from_qureg(q), vec2, atol=ATOL)
+
+
+def test_clone_qureg(env):
+    rng = np.random.default_rng(9)
+    vec = oracle.random_state(N, rng)
+    src = qt.createQureg(N, env)
+    oracle.set_qureg_from_array(qt, src, vec)
+    dst = qt.createQureg(N, env)
+    qt.cloneQureg(dst, src)
+    np.testing.assert_allclose(oracle.state_from_qureg(dst), vec, atol=ATOL)
+    # mutating the clone must not touch the source
+    qt.pauliX(dst, 0)
+    np.testing.assert_allclose(oracle.state_from_qureg(src), vec, atol=ATOL)
+    clone = qt.createCloneQureg(src, env)
+    np.testing.assert_allclose(oracle.state_from_qureg(clone), vec, atol=ATOL)
+
+
+def test_init_validation(env):
+    q = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid state index"):
+        qt.initClassicalState(q, DIM)
+    with pytest.raises(qt.QuESTError, match="Incorrect number of amplitudes"):
+        qt.initStateFromAmps(q, np.zeros(3), np.zeros(3))
+    rho = qt.createDensityQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.setAmps(rho, 0, np.zeros(1), np.zeros(1), 1)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.initPureState(q, rho)
