@@ -393,7 +393,9 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
     QuEST_cpu_distributed.c:366-371 as a trace-time branch."""
     env = qureg.env
     n = _sv_n(qureg)
-    ndev = env.num_devices
+    # size of the amplitude-sharding axis, NOT total devices: meshes may
+    # carry extra axes (e.g. the (dp, amps) training mesh)
+    ndev = PAR.amp_axis_size(env.mesh) if env.mesh is not None else 1
     amps = qureg.amps
     if ndev > 1 and (1 << n) > ndev and PAR.explicit_dist_enabled():
         nloc = n - PAR.num_shard_bits(env.mesh)

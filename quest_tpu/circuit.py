@@ -1,0 +1,316 @@
+"""Circuit scheduler: fold gate streams into fused cluster passes.
+
+The reference executes circuits gate-at-a-time through its dispatch layer
+(QuEST/src/QuEST.c) — every gate is one full sweep of the amplitude array.
+This module is the TPU-native replacement for that dispatch loop: a
+*scheduler* that plans a whole gate list into a short program of
+
+    ('fused',   matA, matB)   one HBM pass applying two 7-qubit cluster
+                              unitaries (ops/fused.py Pallas kernel)
+    ('apply',   targets, mat) fallback standard kernel (cluster-spanning
+                              gates, e.g. a CNOT across the 6/7 boundary)
+    ('permute', perm)         one-pass qubit relabel pulling upcoming high
+                              targets into the cluster window — the
+                              single-chip analogue of the reference's
+                              distributed SWAP-relocalization
+                              (QuEST_cpu_distributed.c:1503-1545)
+
+Planning is pure Python over *static* gate structure (targets), so it runs
+once at trace time; gate matrices stay traced values, so parameterised
+circuits recompile only when their shape changes, never when angles change.
+
+The same planning algorithm is implemented natively in C++
+(native/scheduler.cc) for large gate streams; plan_circuit() transparently
+uses it when the native library is built (see native/__init__.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import cplx, fused, kernels
+
+LANE = fused.LANE_QUBITS            # 7
+WINDOW = fused.CLUSTER_QUBITS       # 14
+DIM = fused.CLUSTER_DIM             # 128
+
+
+@dataclass(frozen=True)
+class Gate:
+    """One dense gate: ``mat`` is stacked SoA (2, 2^k, 2^k) over ``targets``
+    (targets[0] = least-significant matrix bit, reference convention)."""
+
+    targets: Tuple[int, ...]
+    mat: object  # array-like; may be a traced jnp value
+
+
+def controlled_dense(mat_soa, num_controls: int):
+    """Embed a k-qubit SoA matrix as a (num_controls+k)-qubit controlled
+    matrix (controls = the high matrix bits, all conditioned on 1) so
+    controlled gates can enter the dense scheduling path."""
+    m = np.asarray(mat_soa) if not isinstance(mat_soa, jnp.ndarray) else mat_soa
+    d = m.shape[-1]
+    full = d << num_controls
+    if isinstance(m, np.ndarray):
+        out = np.zeros((2, full, full), dtype=m.dtype)
+        out[0, : full - d, : full - d] = np.eye(full - d)
+        out[:, full - d :, full - d :] = m
+        return out
+    eye = np.zeros((2, full, full))
+    eye[0, : full - d, : full - d] = np.eye(full - d)
+    return jnp.asarray(eye, m.dtype).at[:, full - d :, full - d :].set(m)
+
+
+# ---------------------------------------------------------------------------
+# Cluster embedding: k-qubit matrix -> 128x128 via static index arrays
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _embed_indices(bits: Tuple[int, ...]):
+    """Static (row, col, mask) arrays embedding a 2^k matrix on cluster bits
+    ``bits`` into the 128x128 cluster space: E[i,j] = U[r[i,j], c[i,j]] *
+    mask[i,j] — the insertZeroBit index algebra of the reference
+    (QuEST_cpu.c:1901-1985) expressed as precomputed gathers."""
+    k = len(bits)
+    idx = np.arange(DIM)
+    sub = np.zeros(DIM, dtype=np.int64)
+    for pos, b in enumerate(bits):
+        sub |= ((idx >> b) & 1) << pos
+    rest = idx.copy()
+    for b in bits:
+        rest &= ~(1 << b)
+    mask = (rest[:, None] == rest[None, :]).astype(np.float64)
+    row = sub[:, None] * np.ones((1, DIM), dtype=np.int64)
+    col = np.ones((DIM, 1), dtype=np.int64) * sub[None, :]
+    return row, col, mask
+
+
+def embed_in_cluster(mat_soa, bits: Tuple[int, ...]):
+    """SoA (2, 2^k, 2^k) gate on cluster bits -> SoA (2, 128, 128)."""
+    row, col, mask = _embed_indices(tuple(bits))
+    m = jnp.asarray(mat_soa)
+    e = m[:, row, col] * jnp.asarray(mask, m.dtype)
+    return e
+
+
+def soa_matmul(a, b):
+    """Complex matrix product of stacked SoA matrices."""
+    hi = jax.lax.Precision.HIGHEST
+    re = jnp.matmul(a[0], b[0], precision=hi) - jnp.matmul(a[1], b[1], precision=hi)
+    im = jnp.matmul(a[0], b[1], precision=hi) + jnp.matmul(a[1], b[0], precision=hi)
+    return jnp.stack([re, im])
+
+
+_EYE128 = None
+
+
+def _eye_cluster():
+    global _EYE128
+    if _EYE128 is None:
+        _EYE128 = np.stack([np.eye(DIM), np.zeros((DIM, DIM))])
+    return _EYE128
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+class _Plan:
+    """Mutable planning state; emits the op program."""
+
+    def __init__(self, num_qubits: int):
+        self.n = num_qubits
+        # pos[logical qubit] = current physical position
+        self.pos = list(range(num_qubits))
+        self.ops: List[tuple] = []
+        self.accA = None  # traced (2,128,128) or None
+        self.accB = None
+        self.count = 0  # gates folded since last flush
+
+    def _fold(self, cluster: str, bits: Tuple[int, ...], mat):
+        e = embed_in_cluster(mat, bits)
+        acc = self.accA if cluster == "A" else self.accB
+        acc = e if acc is None else soa_matmul(e, acc)
+        if cluster == "A":
+            self.accA = acc
+        else:
+            self.accB = acc
+        self.count += 1
+
+    def flush(self):
+        if self.count == 0:
+            return
+        eye = _eye_cluster()
+        a = self.accA if self.accA is not None else eye
+        b = self.accB if self.accB is not None else eye
+        self.ops.append(("fused", a, b))
+        self.accA = self.accB = None
+        self.count = 0
+
+    def permute_for(self, working_set: Sequence[int]):
+        """Emit a relabel placing ``working_set`` (physical positions, first-
+        use order) into the low window.  Positions already < WINDOW keep
+        their slot when possible; high ones displace low positions that are
+        NOT in the working set."""
+        self.flush()
+        n = self.n
+        ws = list(dict.fromkeys(working_set))[: min(WINDOW, n)]
+        high = [p for p in ws if p >= WINDOW]
+        if not high:
+            return
+        ws_set = set(ws)
+        free_low = [p for p in range(min(WINDOW, n)) if p not in ws_set]
+        # perm[new_position] = old_position
+        perm = list(range(n))
+        for p in high:
+            f = free_low.pop(0)
+            perm[f], perm[p] = p, f
+        self.ops.append(("permute", tuple(perm)))
+        # update logical->physical: logical q at old position perm[new] is
+        # now at new position
+        old_to_new = {old: new for new, old in enumerate(perm)}
+        self.pos = [old_to_new[p] for p in self.pos]
+
+    def final_restore(self):
+        self.flush()
+        if self.pos != list(range(self.n)):
+            # physical position p holds logical self.pos^{-1}[p]; emit the
+            # relabel putting logical q back at position q:
+            # perm[new=q] = old position of logical q = pos[q]
+            self.ops.append(("permute", tuple(self.pos)))
+            self.pos = list(range(self.n))
+
+
+def _cluster_of(phys: Sequence[int]) -> Optional[str]:
+    if all(p < LANE for p in phys):
+        return "A"
+    if all(LANE <= p < WINDOW for p in phys):
+        return "B"
+    return None
+
+
+def materialize_plan(structural: Sequence[tuple],
+                     gates: Sequence[Gate]) -> List[tuple]:
+    """Turn a structural plan (gate indices, from the native C++ scheduler)
+    into the executable op list by folding the referenced gate matrices."""
+    ops: List[tuple] = []
+    eye = _eye_cluster()
+    for op in structural:
+        if op[0] == "fused":
+            mats = []
+            for side in (op[1], op[2]):
+                acc = None
+                for gi, bits in side:
+                    e = embed_in_cluster(gates[gi].mat, bits)
+                    acc = e if acc is None else soa_matmul(e, acc)
+                mats.append(eye if acc is None else acc)
+            ops.append(("fused", mats[0], mats[1]))
+        elif op[0] == "apply":
+            ops.append(("apply", op[2], gates[op[1]].mat))
+        else:
+            ops.append(op)
+    return ops
+
+
+def plan_circuit(gates: Sequence[Gate], num_qubits: int,
+                 use_native: Optional[bool] = None) -> List[tuple]:
+    """Plan a gate list: native C++ scheduler when built (see native/),
+    Python fallback otherwise — identical algorithm and output."""
+    from . import native
+
+    if use_native is None:
+        use_native = native.native_available()
+    if use_native:
+        structural = native.plan_native([g.targets for g in gates], num_qubits)
+        if structural is not None:
+            return materialize_plan(structural, gates)
+    return plan_circuit_py(gates, num_qubits)
+
+
+def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
+    """Greedy one-pass scheduler with first-use lookahead for permutations."""
+    n = num_qubits
+    if n < WINDOW:
+        # Too small for the cluster kernel: program = plain per-gate applies.
+        return [("apply", g.targets, g.mat) for g in gates]
+
+    plan = _Plan(n)
+    glist = list(gates)
+    for gi, g in enumerate(glist):
+        phys = tuple(plan.pos[t] for t in g.targets)
+        cl = _cluster_of(phys)
+        if cl is not None:
+            bits = tuple(p if cl == "A" else p - LANE for p in phys)
+            plan._fold(cl, bits, g.mat)
+            continue
+        if all(p < WINDOW for p in phys):
+            # spans both clusters: flush, apply via the standard kernel
+            plan.flush()
+            plan.ops.append(("apply", phys, g.mat))
+            continue
+        # high target: permute the upcoming working set into the window
+        ws: List[int] = []
+        for h in glist[gi:]:
+            for t in h.targets:
+                p = plan.pos[t]
+                if p not in ws:
+                    ws.append(p)
+            if len(ws) >= WINDOW:
+                break
+        plan.permute_for(ws)
+        phys = tuple(plan.pos[t] for t in g.targets)
+        cl = _cluster_of(phys)
+        if cl is not None:
+            bits = tuple(p if cl == "A" else p - LANE for p in phys)
+            plan._fold(cl, bits, g.mat)
+        else:
+            plan.flush()
+            plan.ops.append(("apply", phys, g.mat))
+    plan.final_restore()
+    return plan.ops
+
+
+def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
+                 interpret: Optional[bool] = None):
+    n = num_qubits
+    for op in ops:
+        if op[0] == "fused":
+            amps = fused.apply_cluster_pair(
+                amps, jnp.asarray(op[1], amps.dtype), jnp.asarray(op[2], amps.dtype),
+                num_qubits=n, interpret=interpret,
+            )
+        elif op[0] == "apply":
+            amps = kernels.apply_matrix(
+                amps, jnp.asarray(op[2], amps.dtype), num_qubits=n,
+                targets=tuple(op[1]),
+            )
+        elif op[0] == "permute":
+            amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
+        else:  # pragma: no cover
+            raise ValueError(f"unknown op {op[0]}")
+    return amps
+
+
+def apply_circuit(amps, gates: Sequence[Gate], num_qubits: int,
+                  interpret: Optional[bool] = None):
+    """Plan + execute in one call (both happen at trace time under jit)."""
+    return execute_plan(amps, plan_circuit(gates, num_qubits), num_qubits,
+                        interpret=interpret)
+
+
+def stats(ops: Sequence[tuple]) -> dict:
+    """Pass-count accounting for logging/benchmark output."""
+    from collections import Counter
+
+    c = Counter(op[0] for op in ops)
+    return {"fused": c.get("fused", 0), "apply": c.get("apply", 0),
+            "permute": c.get("permute", 0), "total_passes": sum(c.values())}

@@ -1,0 +1,147 @@
+"""ctypes binding + on-demand build of the native C++ circuit scheduler.
+
+The library (scheduler.cc) is compiled once with g++ into _qts.so next to
+this file; if the toolchain is unavailable the import degrades gracefully
+and circuit.py falls back to its Python planner (same algorithm — the
+native path exists for million-gate streams where per-gate Python
+bookkeeping dominates).  Disable with QT_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "scheduler.cc")
+_LIB = os.path.join(_DIR, "_qts.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _LIB)  # atomic: concurrent readers never see a torn .so
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib():
+    """Load (building if needed) the native scheduler; None if unavailable."""
+    global _lib, _build_failed
+    if os.environ.get("QT_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.qts_plan.restype = ctypes.c_int
+        lib.qts_plan.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.qts_free.restype = None
+        lib.qts_free.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def plan_native(target_lists: Sequence[Sequence[int]],
+                num_qubits: int) -> Optional[List[tuple]]:
+    """Run the C++ planner over gate target lists.
+
+    Returns a *structural* plan — ops referencing gates by index:
+      ('fused', [(gate_idx, bits), ...A], [(gate_idx, bits), ...B])
+      ('apply', gate_idx, phys_targets)
+      ('permute', perm)
+    or None when the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    offsets = np.zeros(len(target_lists) + 1, dtype=np.int64)
+    for i, t in enumerate(target_lists):
+        offsets[i + 1] = offsets[i] + len(t)
+    flat = np.fromiter(
+        (q for t in target_lists for q in t), dtype=np.int64,
+        count=int(offsets[-1]),
+    )
+    if flat.size == 0:
+        flat = np.zeros(1, dtype=np.int64)  # valid pointer for ctypes
+    buf = ctypes.POINTER(ctypes.c_int64)()
+    length = ctypes.c_int64()
+    rc = lib.qts_plan(
+        num_qubits, len(target_lists),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(buf), ctypes.byref(length),
+    )
+    if rc != 0:
+        return None
+    try:
+        data = np.ctypeslib.as_array(buf, shape=(length.value,)).copy()
+    finally:
+        lib.qts_free(buf)
+
+    ops: List[tuple] = []
+    i = 1
+    for _ in range(int(data[0])):
+        kind = int(data[i]); i += 1
+        if kind == 0:
+            folds = []
+            for _side in range(2):
+                nf = int(data[i]); i += 1
+                side = []
+                for _f in range(nf):
+                    gi = int(data[i]); k = int(data[i + 1]); i += 2
+                    bits = tuple(int(b) for b in data[i:i + k]); i += k
+                    side.append((gi, bits))
+                folds.append(side)
+            ops.append(("fused", folds[0], folds[1]))
+        elif kind == 1:
+            gi = int(data[i]); k = int(data[i + 1]); i += 2
+            phys = tuple(int(p) for p in data[i:i + k]); i += k
+            ops.append(("apply", gi, phys))
+        elif kind == 2:
+            k = int(data[i]); i += 1
+            perm = tuple(int(p) for p in data[i:i + k]); i += k
+            ops.append(("permute", perm))
+        else:
+            raise ValueError(f"bad plan op kind {kind}")
+    return ops

@@ -1,0 +1,206 @@
+// Native circuit scheduler: the C++ core of quest_tpu's graph-builder.
+//
+// The reference's runtime around its kernels is native C (dispatch layer
+// QuEST/src/QuEST.c; distributed orchestration
+// QuEST/src/CPU/QuEST_cpu_distributed.c).  quest_tpu keeps the same split:
+// JAX/XLA/Pallas is the compute path, and this C++ library is the runtime
+// piece that *plans* a gate stream into a short program of fused cluster
+// passes, fallback applies, and one-pass qubit permutations (see
+// quest_tpu/circuit.py for the op semantics; the Python planner there is
+// the executable specification of this algorithm, and
+// tests/test_circuit.py asserts the two produce identical plans).
+//
+// Planning is pure integer work over gate target lists — exactly the kind
+// of per-gate host-side bookkeeping that must not sit in Python when
+// circuits reach millions of gates (Trotter/QAOA streams), so it is native.
+//
+// ABI (ctypes, see quest_tpu/native/__init__.py):
+//   qts_plan(n, num_gates, offsets[num_gates+1], targets[], &buf, &len)
+//     -> 0 on success; caller frees with qts_free(buf).
+//
+// Plan serialization (int64 stream):
+//   [num_ops] then per op:
+//     kind 0 (fused):   0, nA, {gate_idx, k, bits[k]} * nA,
+//                          nB, {gate_idx, k, bits[k]} * nB
+//     kind 1 (apply):   1, gate_idx, k, phys_targets[k]
+//     kind 2 (permute): 2, n, perm[n]       (perm[new_pos] = old_pos)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kLane = 7;     // qubits 0..6  -> lane cluster A
+constexpr int kWindow = 14;  // qubits 0..13 -> the fused window
+
+struct Fold {
+  int64_t gate;
+  std::vector<int64_t> bits;
+};
+
+struct Plan {
+  std::vector<int64_t> buf;  // serialized ops (without leading count)
+  int64_t num_ops = 0;
+  std::vector<int64_t> pos;  // pos[logical] = physical
+  std::vector<Fold> accA, accB;
+
+  explicit Plan(int64_t n) : pos(n) {
+    for (int64_t q = 0; q < n; ++q) pos[q] = q;
+  }
+
+  void flush() {
+    if (accA.empty() && accB.empty()) return;
+    buf.push_back(0);
+    for (auto* acc : {&accA, &accB}) {
+      buf.push_back(static_cast<int64_t>(acc->size()));
+      for (const Fold& f : *acc) {
+        buf.push_back(f.gate);
+        buf.push_back(static_cast<int64_t>(f.bits.size()));
+        buf.insert(buf.end(), f.bits.begin(), f.bits.end());
+      }
+    }
+    accA.clear();
+    accB.clear();
+    ++num_ops;
+  }
+
+  void emit_permute(const std::vector<int64_t>& perm) {
+    buf.push_back(2);
+    buf.push_back(static_cast<int64_t>(perm.size()));
+    buf.insert(buf.end(), perm.begin(), perm.end());
+    ++num_ops;
+    // content of old position perm[new] lands at new; update logical map
+    std::vector<int64_t> old_to_new(perm.size());
+    for (size_t np = 0; np < perm.size(); ++np) old_to_new[perm[np]] = np;
+    for (auto& p : pos) p = old_to_new[p];
+  }
+
+  void emit_apply(int64_t gate, const std::vector<int64_t>& phys) {
+    buf.push_back(1);
+    buf.push_back(gate);
+    buf.push_back(static_cast<int64_t>(phys.size()));
+    buf.insert(buf.end(), phys.begin(), phys.end());
+    ++num_ops;
+  }
+};
+
+// 0 = cluster A, 1 = cluster B, -1 = neither
+int cluster_of(const std::vector<int64_t>& phys) {
+  bool a = true, b = true;
+  for (int64_t p : phys) {
+    if (p >= kLane) a = false;
+    if (p < kLane || p >= kWindow) b = false;
+  }
+  if (a) return 0;
+  if (b) return 1;
+  return -1;
+}
+
+void fold(Plan& plan, int cl, int64_t gate, const std::vector<int64_t>& phys) {
+  Fold f;
+  f.gate = gate;
+  for (int64_t p : phys) f.bits.push_back(cl == 0 ? p : p - kLane);
+  (cl == 0 ? plan.accA : plan.accB).push_back(std::move(f));
+}
+
+}  // namespace
+
+extern "C" {
+
+int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
+             const int64_t* targets, int64_t** out_buf, int64_t* out_len) {
+  if (n <= 0 || num_gates < 0 || !offsets || !out_buf || !out_len) return 1;
+  for (int64_t i = 0; i < offsets[num_gates]; ++i)
+    if (targets[i] < 0 || targets[i] >= n) return 3;  // bad target qubit
+  Plan plan(n);
+
+  auto phys_of = [&](int64_t g) {
+    std::vector<int64_t> phys;
+    for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i)
+      phys.push_back(plan.pos[targets[i]]);
+    return phys;
+  };
+
+  if (n < kWindow) {
+    // too small for the cluster kernel: plain per-gate applies
+    for (int64_t g = 0; g < num_gates; ++g) plan.emit_apply(g, phys_of(g));
+  } else {
+    for (int64_t g = 0; g < num_gates; ++g) {
+      std::vector<int64_t> phys = phys_of(g);
+      int cl = cluster_of(phys);
+      if (cl >= 0) {
+        fold(plan, cl, g, phys);
+        continue;
+      }
+      bool in_window = true;
+      for (int64_t p : phys) in_window = in_window && p < kWindow;
+      if (in_window) {
+        plan.flush();
+        plan.emit_apply(g, phys);
+        continue;
+      }
+      // high target: gather the upcoming working set (first-use order)
+      std::vector<int64_t> ws;
+      for (int64_t h = g; h < num_gates && (int64_t)ws.size() < kWindow; ++h) {
+        for (int64_t i = offsets[h]; i < offsets[h + 1]; ++i) {
+          int64_t p = plan.pos[targets[i]];
+          bool seen = false;
+          for (int64_t w : ws) seen = seen || (w == p);
+          if (!seen) ws.push_back(p);
+        }
+      }
+      if ((int64_t)ws.size() > (n < kWindow ? n : (int64_t)kWindow))
+        ws.resize(kWindow);
+      plan.flush();
+      std::vector<int64_t> high;
+      for (int64_t p : ws)
+        if (p >= kWindow) high.push_back(p);
+      if (!high.empty()) {
+        std::vector<bool> in_ws(n, false);
+        for (int64_t p : ws) in_ws[p] = true;
+        std::vector<int64_t> free_low;
+        for (int64_t p = 0; p < kWindow; ++p)
+          if (!in_ws[p]) free_low.push_back(p);
+        std::vector<int64_t> perm(n);
+        for (int64_t p = 0; p < n; ++p) perm[p] = p;
+        size_t fi = 0;
+        for (int64_t p : high) {
+          int64_t f = free_low[fi++];
+          perm[f] = p;
+          perm[p] = f;
+        }
+        plan.emit_permute(perm);
+      }
+      phys = phys_of(g);
+      cl = cluster_of(phys);
+      if (cl >= 0) {
+        fold(plan, cl, g, phys);
+      } else {
+        plan.flush();
+        plan.emit_apply(g, phys);
+      }
+    }
+    plan.flush();
+    // restore logical order: perm[new=q] = pos[q]
+    bool identity = true;
+    for (int64_t q = 0; q < n; ++q) identity = identity && plan.pos[q] == q;
+    if (!identity) plan.emit_permute(plan.pos);
+  }
+  plan.flush();
+
+  int64_t len = static_cast<int64_t>(plan.buf.size()) + 1;
+  auto* buf = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * len));
+  if (!buf) return 2;
+  buf[0] = plan.num_ops;
+  if (!plan.buf.empty())
+    std::memcpy(buf + 1, plan.buf.data(), sizeof(int64_t) * plan.buf.size());
+  *out_buf = buf;
+  *out_len = len;
+  return 0;
+}
+
+void qts_free(int64_t* buf) { std::free(buf); }
+
+}  // extern "C"

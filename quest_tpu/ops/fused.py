@@ -1,0 +1,151 @@
+"""Fused cluster-pair Pallas kernel: many gates, ONE pass over HBM.
+
+The reference applies one kernel sweep per gate (QuEST.c dispatch; e.g.
+compactUnitaryLocal, QuEST/src/CPU/QuEST_cpu.c:1743-1800), so a depth-d
+circuit costs d full passes over the 2^n-amplitude array.  On TPU the state
+sweep is HBM-bandwidth-bound, so the win is to apply MANY gates per pass.
+
+Design: the flat amplitude index is split little-endian as
+
+    [ qubits 14..n-1 | qubits 7..13 | qubits 0..6 ]
+         grid rows       sublanes       lanes
+
+so a (2, R, 128, 128) VMEM block holds R*16384 amplitudes with qubits 0..6
+as the lane dimension and 7..13 as the sublane dimension — both exactly
+TPU-tile-aligned for f32.  Any sequence of gates confined to qubits 0..6
+multiplies into ONE 128x128 "cluster" matrix A (likewise 7..13 into B), and
+the kernel applies A (right-contraction over lanes) and B (left-contraction
+over sublanes) to each block while it is VMEM-resident: two MXU matmuls,
+one HBM read + one write, regardless of how many gates were folded in.
+
+Complex arithmetic stays SoA (ops/cplx.py): the two channels are
+concatenated along the contracted axis and each cluster matrix becomes the
+256x256 real representation [[Re,Im],[-Im,Re]] (lanes) / [[Re,-Im],[Im,Re]]
+(sublanes), so each cluster costs exactly one real matmul.
+
+Gates on qubits >= 14 are handled by the circuit scheduler (circuit.py)
+with a one-pass axis permutation (kernels.permute_qubits) that relabels
+high qubits into the cluster window — the single-chip analogue of the
+reference's distributed SWAP-relocalization
+(QuEST/src/CPU/QuEST_cpu_distributed.c:1503-1545).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE_QUBITS = 7          # qubits 0..6  -> lane dim (128)
+SUBLANE_QUBITS = 7       # qubits 7..13 -> sublane dim (128)
+CLUSTER_QUBITS = LANE_QUBITS + SUBLANE_QUBITS   # 14
+CLUSTER_DIM = 128
+BLOCK_AMPS = CLUSTER_DIM * CLUSTER_DIM           # 16384
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def lane_real_rep(mat_soa):
+    """(2,128,128) SoA cluster matrix -> (256,256) real right-multiplier.
+
+    For x = [xr | xi] concatenated on the lane axis, x @ M computes the
+    complex product U x with U acting on the lane index:
+    M = [[Ar^T, Ai^T], [-Ai^T, Ar^T]].
+    """
+    ar, ai = mat_soa[0], mat_soa[1]
+    top = jnp.concatenate([ar.T, ai.T], axis=1)
+    bot = jnp.concatenate([-ai.T, ar.T], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def sublane_real_rep(mat_soa):
+    """(2,128,128) SoA cluster matrix -> (256,256) real left-multiplier.
+
+    For y = [yr ; yi] stacked on the sublane axis, M @ y computes the
+    complex product: M = [[Br, -Bi], [Bi, Br]].
+    """
+    br, bi = mat_soa[0], mat_soa[1]
+    top = jnp.concatenate([br, -bi], axis=1)
+    bot = jnp.concatenate([bi, br], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def _cluster_kernel(a_ref, ma_ref, mb_ref, o_ref):
+    x = a_ref[...]                      # (2, R, 128, 128)
+    xr, xi = x[0], x[1]
+    # lane cluster: right-contract lanes with the 256x256 real rep
+    xc = jnp.concatenate([xr, xi], axis=-1)          # (R, 128, 256)
+    xc = jax.lax.dot_general(
+        xc, ma_ref[...],
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                # (R, 128, 256)
+    xr, xi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+    # sublane cluster: left-contract sublanes
+    yc = jnp.concatenate([xr, xi], axis=1)           # (R, 256, 128)
+    out = jax.lax.dot_general(
+        mb_ref[...], yc,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                # (256, R, 128)
+    out = jnp.moveaxis(out, 0, 1)                    # (R, 256, 128)
+    o_ref[...] = jnp.stack(
+        [out[:, :CLUSTER_DIM], out[:, CLUSTER_DIM:]], axis=0
+    )
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
+         donate_argnums=0)
+def apply_cluster_pair(
+    amps,
+    mat_a,
+    mat_b,
+    *,
+    num_qubits: int,
+    block_rows: int = 8,
+    interpret: bool | None = None,
+):
+    """Apply 7-qubit cluster unitaries A (qubits 0-6) and B (qubits 7-13)
+    to the whole state in one HBM pass.
+
+    ``amps``: SoA (2, 2^n), n >= 14.  ``mat_a``/``mat_b``: stacked SoA
+    (2, 128, 128) — products of all folded gates, built by circuit.py.
+    """
+    n = num_qubits
+    if n < CLUSTER_QUBITS:
+        raise ValueError(f"apply_cluster_pair needs >= {CLUSTER_QUBITS} qubits")
+    if interpret is None:
+        interpret = _interpret_default()
+    nb = 1 << (n - CLUSTER_QUBITS)
+    r = min(block_rows, nb)
+    while nb % r:
+        r //= 2
+    ma = lane_real_rep(jnp.asarray(mat_a, amps.dtype))
+    mb = sublane_real_rep(jnp.asarray(mat_b, amps.dtype))
+    view = amps.reshape(2, nb, CLUSTER_DIM, CLUSTER_DIM)
+    out = pl.pallas_call(
+        _cluster_kernel,
+        grid=(nb // r,),
+        in_specs=[
+            pl.BlockSpec((2, r, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i: (0, 0)),
+            pl.BlockSpec((2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, r, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, ma, mb)
+    return out.reshape(2, -1)

@@ -251,6 +251,20 @@ def apply_multi_qubit_not(
     return view.reshape(2, -1)
 
 
+@partial(jax.jit, static_argnames=("num_qubits", "perm"), donate_argnums=0)
+def permute_qubits(amps, *, num_qubits: int, perm: Tuple[int, ...]):
+    """Relabel qubits in ONE transpose pass: output qubit q holds what input
+    qubit perm[q] held.  Generalizes swap_qubit_amps to arbitrary
+    permutations — the single-chip analogue of the reference's distributed
+    SWAP-relocalization (QuEST_cpu_distributed.c:1503-1545), used by the
+    fused-circuit scheduler (circuit.py) to rotate high qubits into the
+    Pallas cluster window at one-HBM-pass cost."""
+    n = num_qubits
+    view = amps.reshape((2,) + (2,) * n)
+    axes = (0,) + tuple(_axis(n, perm[n - 1 - i]) for i in range(n))
+    return jnp.transpose(view, axes).reshape(2, -1)
+
+
 @partial(jax.jit, static_argnames=("num_qubits", "qb1", "qb2"), donate_argnums=0)
 def swap_qubit_amps(amps, *, num_qubits: int, qb1: int, qb2: int):
     """SWAP gate = transpose of two index axes (reference swapQubitAmps,

@@ -122,8 +122,9 @@ def apply_matrix_1q_sharded(
     bit = target - nloc
     perm = _hypercube_perm(ndev, bit)
 
-    local_controls = tuple((c, s) for c, s in zip(controls, control_states or (1,) * len(controls)) if c < nloc)
-    shard_controls = tuple((c - nloc, s) for c, s in zip(controls, control_states or (1,) * len(controls)) if c >= nloc)
+    states = control_states or (1,) * len(controls)
+    local_controls = tuple((c, s) for c, s in zip(controls, states) if c < nloc)
+    shard_controls = tuple((c - nloc, s) for c, s in zip(controls, states) if c >= nloc)
 
     def kernel(local, m):
         # local: (2, amps_per_shard); m: (2, 2, 2) stacked SoA

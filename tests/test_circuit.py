@@ -1,0 +1,235 @@
+"""Fused-circuit scheduler tests: Pallas cluster kernel (interpret mode on
+CPU), the Python planner, the native C++ planner, and end-to-end circuit
+equivalence against the gate-at-a-time kernel path (the reference's
+execution model, QuEST/src/QuEST.c dispatch)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quest_tpu import circuit as C
+from quest_tpu import native
+from quest_tpu.ops import cplx, fused, kernels
+
+from oracle import random_unitary
+
+CNOT = np.array(
+    [[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0]], dtype=complex
+)
+
+
+def _rand_state(rng, n):
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    return amps / np.sqrt((amps ** 2).sum())
+
+
+def _apply_gatewise(amps0, gates, n):
+    ref = jnp.asarray(amps0)
+    for g in gates:
+        ref = kernels.apply_matrix(
+            ref, jnp.asarray(g.mat), num_qubits=n, targets=g.targets
+        )
+    return np.asarray(ref)
+
+
+def _layered_circuit(rng, n, depth):
+    gates = []
+    for d in range(depth):
+        for q in range(n):
+            gates.append(C.Gate((q,), cplx.soa(random_unitary(1, rng)).astype(np.float32)))
+        for q in range(d % 2, n - 1, 2):
+            gates.append(C.Gate((q, q + 1), cplx.soa(CNOT).astype(np.float32)))
+    return gates
+
+
+class TestClusterKernel:
+    def test_identity(self):
+        rng = np.random.default_rng(0)
+        amps = _rand_state(rng, 14)
+        eye = np.stack([np.eye(128), np.zeros((128, 128))]).astype(np.float32)
+        out = fused.apply_cluster_pair(
+            jnp.asarray(amps), eye, eye, num_qubits=14
+        )
+        np.testing.assert_allclose(np.asarray(out), amps, atol=1e-6)
+
+    @pytest.mark.parametrize("n", [14, 15, 17])
+    def test_matches_gatewise(self, n):
+        rng = np.random.default_rng(n)
+        amps = _rand_state(rng, n)
+        us = [random_unitary(1, rng) for _ in range(14)]
+        ref = jnp.asarray(amps)
+        for q in range(14):
+            ref = kernels.apply_matrix(
+                ref, jnp.asarray(cplx.soa(us[q]), jnp.float32),
+                num_qubits=n, targets=(q,),
+            )
+        a = us[6]
+        for u in us[5::-1]:
+            a = np.kron(a, u)
+        b = us[13]
+        for u in us[12:6:-1]:
+            b = np.kron(b, u)
+        out = fused.apply_cluster_pair(
+            jnp.asarray(amps),
+            jnp.asarray(cplx.soa(a), jnp.float32),
+            jnp.asarray(cplx.soa(b), jnp.float32),
+            num_qubits=n,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+
+    def test_too_small_raises(self):
+        eye = np.stack([np.eye(128), np.zeros((128, 128))]).astype(np.float32)
+        with pytest.raises(ValueError):
+            fused.apply_cluster_pair(
+                jnp.zeros((2, 1 << 10), jnp.float32), eye, eye, num_qubits=10
+            )
+
+
+class TestPermuteQubits:
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_against_index_oracle(self, n):
+        rng = np.random.default_rng(n)
+        amps = _rand_state(rng, n)
+        perm = tuple(rng.permutation(n).tolist())
+        out = np.asarray(
+            kernels.permute_qubits(jnp.asarray(amps), num_qubits=n, perm=perm)
+        )
+        idx = np.arange(1 << n)
+        src = np.zeros_like(idx)
+        for q in range(n):
+            src |= ((idx >> q) & 1) << perm[q]
+        np.testing.assert_allclose(out, amps[:, src], atol=0)
+
+    def test_swap_equivalence(self):
+        rng = np.random.default_rng(3)
+        n = 6
+        amps = _rand_state(rng, n)
+        perm = list(range(n))
+        perm[1], perm[4] = perm[4], perm[1]
+        out = kernels.permute_qubits(
+            jnp.asarray(amps), num_qubits=n, perm=tuple(perm)
+        )
+        ref = kernels.swap_qubit_amps(jnp.asarray(amps), num_qubits=n, qb1=1, qb2=4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0)
+
+
+class TestEmbedding:
+    def test_embed_1q(self):
+        rng = np.random.default_rng(5)
+        u = random_unitary(1, rng)
+        for b in range(7):
+            e = cplx.unsoa(np.asarray(C.embed_in_cluster(cplx.soa(u), (b,))))
+            expect = np.kron(
+                np.kron(np.eye(1 << (6 - b)), u), np.eye(1 << b)
+            )
+            np.testing.assert_allclose(e, expect, atol=1e-12)
+
+    def test_embed_2q_nonadjacent(self):
+        rng = np.random.default_rng(6)
+        u = random_unitary(2, rng)
+        e = cplx.unsoa(np.asarray(C.embed_in_cluster(cplx.soa(u), (1, 4))))
+        # oracle: E[i,j] = U[x(i), x(j)] when the other bits agree
+        idx = np.arange(128)
+        x = ((idx >> 1) & 1) | (((idx >> 4) & 1) << 1)
+        rest = idx & ~0b10010
+        expect = u[x[:, None], x[None, :]] * (rest[:, None] == rest[None, :])
+        np.testing.assert_allclose(e, expect, atol=1e-12)
+
+    def test_controlled_dense(self):
+        rng = np.random.default_rng(7)
+        u = random_unitary(1, rng)
+        cu = cplx.unsoa(C.controlled_dense(cplx.soa(u), 1))
+        expect = np.eye(4, dtype=complex)
+        expect[2:, 2:] = u
+        np.testing.assert_allclose(cu, expect, atol=1e-12)
+
+
+class TestScheduler:
+    @pytest.mark.parametrize("n,depth", [(14, 2), (15, 3), (16, 2)])
+    def test_e2e_matches_gatewise(self, n, depth):
+        rng = np.random.default_rng(100 + n)
+        gates = _layered_circuit(rng, n, depth)
+        amps0 = _rand_state(rng, n)
+        ref = _apply_gatewise(amps0, gates, n)
+        out = np.asarray(C.apply_circuit(jnp.asarray(amps0), gates, n))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_pass_reduction(self):
+        rng = np.random.default_rng(9)
+        gates = _layered_circuit(rng, 16, 4)
+        ops = C.plan_circuit_py(gates, 16)
+        st = C.stats(ops)
+        assert st["total_passes"] < len(gates) // 2
+
+    def test_small_n_fallback(self):
+        rng = np.random.default_rng(11)
+        gates = [
+            C.Gate((q,), cplx.soa(random_unitary(1, rng)).astype(np.float32))
+            for q in range(5)
+        ]
+        ops = C.plan_circuit(gates, 5)
+        assert all(o[0] == "apply" for o in ops)
+        amps0 = _rand_state(rng, 5)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, 5))
+        ref = _apply_gatewise(amps0, gates, 5)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_high_qubit_2q_gate(self):
+        rng = np.random.default_rng(12)
+        n = 16
+        gates = [
+            C.Gate((14, 15), cplx.soa(random_unitary(2, rng)).astype(np.float32)),
+            C.Gate((0, 15), cplx.soa(CNOT).astype(np.float32)),
+        ]
+        amps0 = _rand_state(rng, n)
+        ref = _apply_gatewise(amps0, gates, n)
+        out = np.asarray(C.apply_circuit(jnp.asarray(amps0), gates, n))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestNativeScheduler:
+    def test_available(self):
+        assert native.native_available(), "native scheduler failed to build"
+
+    @pytest.mark.parametrize("n,depth", [(14, 2), (16, 3), (20, 2)])
+    def test_plans_match_python(self, n, depth):
+        rng = np.random.default_rng(200 + n)
+        gates = _layered_circuit(rng, n, depth)
+        ops_py = C.plan_circuit_py(gates, n)
+        ops_nat = C.plan_circuit(gates, n, use_native=True)
+        assert [o[0] for o in ops_py] == [o[0] for o in ops_nat]
+        for a, b in zip(ops_py, ops_nat):
+            if a[0] == "permute":
+                assert a[1] == b[1]
+            elif a[0] == "apply":
+                assert tuple(a[1]) == tuple(b[1])
+                np.testing.assert_allclose(np.asarray(a[2]), np.asarray(b[2]))
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(a[1]), np.asarray(b[1]), atol=1e-6
+                )
+                np.testing.assert_allclose(
+                    np.asarray(a[2]), np.asarray(b[2]), atol=1e-6
+                )
+
+    def test_native_e2e(self):
+        rng = np.random.default_rng(13)
+        n = 15
+        gates = _layered_circuit(rng, n, 2)
+        amps0 = _rand_state(rng, n)
+        ops = C.plan_circuit(gates, n, use_native=True)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_empty_circuit(self):
+        assert C.plan_circuit([], 16, use_native=True) == []
+
+    def test_out_of_range_target_rejected(self):
+        # native planner must reject bad targets (rc=3), falling back to
+        # the Python planner's IndexError — never a silently wrong plan
+        rng = np.random.default_rng(14)
+        bad = [C.Gate((16,), cplx.soa(random_unitary(1, rng)).astype(np.float32))]
+        assert native.plan_native([(16,)], 16) is None
+        with pytest.raises(IndexError):
+            C.plan_circuit(bad, 16, use_native=True)

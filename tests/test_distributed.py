@@ -72,11 +72,13 @@ def test_explicit_matches_gspmd(env):
         qt.unitary(q, 3, u)
         return oracle.state_from_qureg(q)
 
-    dist.use_explicit_dist(True)
-    a = run()
-    dist.use_explicit_dist(False)
-    b = run()
-    dist.use_explicit_dist(True)
+    try:
+        dist.use_explicit_dist(True)
+        a = run()
+        dist.use_explicit_dist(False)
+        b = run()
+    finally:
+        dist.use_explicit_dist(True)
     np.testing.assert_allclose(a, b, atol=ATOL)
 
 
