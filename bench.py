@@ -42,17 +42,53 @@ BASELINE_AMPS_PER_SEC = 3.17e8
 N = int(os.environ.get("QT_BENCH_QUBITS", "26"))
 DEPTH = int(os.environ.get("QT_BENCH_DEPTH", "20"))
 REPS = int(os.environ.get("QT_BENCH_REPS", "3"))
+# Fused scheduler path (Pallas cluster kernel + permutes, quest_tpu.circuit)
+# vs per-gate einsum path; identical circuit either way.
+FUSED = os.environ.get("QT_BENCH_FUSED", "1") == "1" and N >= 14
+
+
+def _build_fused_program():
+    """Same circuit as circuits.build_random_circuit, as a scheduled plan:
+    gate matrices stay traced args so angle changes never recompile."""
+    import numpy as _np
+
+    from quest_tpu import circuit as C
+
+    # CNOT with control = matrix bit 0 (= targets[0] = q), target = bit 1:
+    # flips bit 1 on states where bit 0 is set (indices 1 <-> 3)
+    cnot = _np.zeros((2, 4, 4), _np.float32)
+    cnot[0] = _np.array(
+        [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], _np.float32
+    )
+
+    def program(amps, us):
+        gates = []
+        for d in range(DEPTH):
+            for q in range(N):
+                gates.append(C.Gate((q,), us[d, q]))
+            for q in range(d % 2, N - 1, 2):
+                gates.append(C.Gate((q, q + 1), cnot))
+        amps = C.apply_circuit(amps, gates, N)
+        prob = calculations.calc_prob_of_outcome_statevec(
+            amps, num_qubits=N, target=N - 1, outcome=0
+        )
+        return amps, prob
+
+    return program
 
 
 def main():
     fn, unitaries = circuits.build_random_circuit(N, DEPTH, seed=7)
 
-    def program(amps, us):
-        amps = fn(amps, us)
-        prob = calculations.calc_prob_of_outcome_statevec(
-            amps, num_qubits=N, target=N - 1, outcome=0
-        )
-        return amps, prob
+    if FUSED:
+        program = _build_fused_program()
+    else:
+        def program(amps, us):
+            amps = fn(amps, us)
+            prob = calculations.calc_prob_of_outcome_statevec(
+                amps, num_qubits=N, target=N - 1, outcome=0
+            )
+            return amps, prob
 
     jprog = jax.jit(program, donate_argnums=0)
 
@@ -85,6 +121,7 @@ def main():
                 "seconds": best,
                 "gates": num_gates,
                 "backend": jax.default_backend(),
+                "fused": FUSED,
                 "prob_check": float(prob),
             }
         )

@@ -1,0 +1,178 @@
+"""Benchmark suite: one JSON line per BASELINE.json config.
+
+Sizes marked (scaled) are reduced from the BASELINE.json pod-scale targets
+to fit the single benchmarking chip (v5e, 16 GB HBM); the workload shape
+(gate mix, reduction structure) is preserved.  bench.py remains the
+driver-facing headline (config 2).
+
+Usage: python bench_suite.py [--config N] [--all]
+       QT_BENCH_CPU=1 for off-TPU smoke runs (tiny sizes).
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+if os.environ.get("QT_BENCH_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+CPU = os.environ.get("QT_BENCH_CPU") == "1"
+
+
+def _time_best(fn, reps=3):
+    """(best_seconds, last_result) — result captured so callers never rerun
+    the workload just to log it."""
+    result = fn()  # warm-up/compile
+    best = math.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+def _emit(config, metric, value, unit, seconds, extra=None):
+    rec = {
+        "config": config,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "seconds": seconds,
+        "backend": jax.default_backend(),
+    }
+    rec.update(extra or {})
+    print(json.dumps(rec), flush=True)
+
+
+def config1():
+    """12q hadamard + controlledRotateX chain + calcProbOfOutcome, through
+    the imperative API (gate-at-a-time dispatch — the reference's model)."""
+    import quest_tpu as qt
+
+    n = 12
+    env = qt.createQuESTEnv()
+
+    def run():
+        q = qt.createQureg(n, env)
+        qt.hadamard(q, 0)
+        for t in range(1, n):
+            qt.controlledRotateX(q, t - 1, t, 0.3)
+        return qt.calcProbOfOutcome(q, n - 1, 0)
+
+    seconds, prob = _time_best(run)
+    gates = n  # 1 H + (n-1) controlled rotations
+    _emit(1, "12q API chain gate rate", gates * (1 << n) / seconds,
+          "amp_updates_per_sec", seconds, {"prob": prob})
+
+
+def config2():
+    """Delegates to bench.py (26q depth-20 random circuit, fused path)."""
+    import bench
+
+    bench.main()
+
+
+def config3():
+    """QFT via fused controlled-phase ladders + swaps (cross-shard exercise
+    on a mesh; single-chip here). Scaled 30q -> 26q (8 GB f32 SoA)."""
+    import jax.numpy as jnp
+
+    from quest_tpu.models import circuits
+    from quest_tpu.ops import kernels
+
+    n = 10 if CPU else 26
+    jqft = jax.jit(lambda a: circuits.qft_circuit(a, n), donate_argnums=0)
+
+    def run():
+        amps = kernels.init_debug_state(1 << n, np.float32)
+        amps /= np.sqrt(float(jnp.sum(amps * amps)))
+        out = jqft(amps)
+        out.block_until_ready()
+        return out
+
+    seconds, _ = _time_best(run)
+    gates = n + n * (n - 1) // 2 + n // 2  # H ladder + CPhase ladder + swaps
+    _emit(3, f"{n}q QFT gate rate", gates * (1 << n) / seconds,
+          "amp_updates_per_sec", seconds, {"gates": gates})
+
+
+def config4():
+    """Density-matrix noise: mixDepolarising + mixTwoQubitKrausMap +
+    calcFidelity. Scaled 20q -> 13q rho (2^26 amps, chip-resident)."""
+    import quest_tpu as qt
+
+    n = 5 if CPU else 13
+    env = qt.createQuESTEnv()
+    rng = np.random.default_rng(5)
+    # random 2-qubit CPTP map (4 Kraus ops)
+    raw = rng.standard_normal((4, 4, 4)) + 1j * rng.standard_normal((4, 4, 4))
+    s = np.zeros((4, 4), dtype=complex)
+    for k in raw:
+        s += k.conj().T @ k
+    w = np.linalg.inv(np.linalg.cholesky(s).conj().T)
+    ops = [k @ w for k in raw]
+
+    def run():
+        rho = qt.createDensityQureg(n, env)
+        qt.initPlusState(rho)
+        for q in range(n):
+            qt.mixDepolarising(rho, q, 0.05)
+        qt.mixTwoQubitKrausMap(rho, 0, 1, ops)
+        psi = qt.createQureg(n, env)
+        qt.initPlusState(psi)
+        return qt.calcFidelity(rho, psi)
+
+    seconds, fidelity = _time_best(run)
+    _emit(4, f"{n}q density noise+fidelity wall-clock", seconds, "seconds",
+          seconds, {"fidelity": fidelity})
+
+
+def config5():
+    """calcExpecPauliHamil + applyTrotterCircuit on a random PauliHamil.
+    Scaled 34q (pod) -> 24q (chip)."""
+    import quest_tpu as qt
+
+    n = 8 if CPU else 24
+    terms = 16
+    env = qt.createQuESTEnv()
+    rng = np.random.default_rng(7)
+    hamil = qt.createPauliHamil(n, terms)
+    codes = rng.integers(0, 4, size=(terms, n))
+    coeffs = rng.standard_normal(terms)
+    qt.initPauliHamil(hamil, coeffs, codes)
+
+    def run():
+        psi = qt.createQureg(n, env)
+        qt.initPlusState(psi)
+        work = qt.createQureg(n, env)
+        e = qt.calcExpecPauliHamil(psi, hamil, work)
+        qt.applyTrotterCircuit(psi, hamil, 0.1, 2, 1)
+        return e
+
+    seconds, energy = _time_best(run)
+    _emit(5, f"{n}q PauliHamil expec+Trotter wall-clock", seconds, "seconds",
+          seconds, {"energy": energy})
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main():
+    if "--config" in sys.argv:
+        which = [int(sys.argv[sys.argv.index("--config") + 1])]
+    else:
+        which = sorted(CONFIGS)
+    for c in which:
+        CONFIGS[c]()
+
+
+if __name__ == "__main__":
+    main()

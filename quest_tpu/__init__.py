@@ -32,6 +32,17 @@ from .env import QuESTEnv
 from .qasm import QASMLogger
 from .api import *  # noqa: F401,F403
 from .api_ops import *  # noqa: F401,F403
+from .checkpoint import (
+    saveQureg,
+    loadQureg,
+    writeStateToFile,
+    readStateFromFile,
+)
+from .debug import (
+    initStateOfSingleQubit,
+    initStateFromSingleFile,
+    compareStates,
+)
 from .ops import phasefunc as _pf
 
 # enum phaseFunc (QuEST.h:231-234)

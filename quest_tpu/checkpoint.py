@@ -1,0 +1,118 @@
+"""Checkpoint / resume: durable snapshots of registers and operators.
+
+The reference's persistence story is minimal — a per-rank CSV dump
+(reportState, QuEST_common.c:229-245), a debug-only CSV loader
+(initStateFromSingleFile, QuEST_cpu.c:1680-1729) and amplitude get/set
+APIs users must script themselves (SURVEY.md §5.4).  This module exceeds
+that: orbax-backed save/restore of the (possibly sharded) amplitude array
+with metadata, so a multi-device register round-trips with its sharding
+reconstructed on the current mesh — plus CSV read/write kept for
+reference-format compatibility.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from .env import QuESTEnv
+from .qureg import Qureg
+from .validation import QuESTError
+
+_META_NAME = "qureg_meta.json"
+_AMPS_NAME = "amps"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def saveQureg(qureg: Qureg, path: str) -> None:
+    """Write a durable snapshot of ``qureg`` (amps + metadata) at ``path``.
+
+    Works for state-vectors and density matrices, any sharding; the write
+    is atomic at the directory level (orbax finalization)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    ckpt.save(os.path.join(path, _AMPS_NAME), {"amps": qureg.amps}, force=True)
+    ckpt.wait_until_finished()
+    meta = {
+        "num_qubits_represented": qureg.num_qubits_represented,
+        "is_density_matrix": qureg.is_density_matrix,
+        "dtype": str(np.dtype(qureg.dtype)),
+    }
+    with open(os.path.join(path, _META_NAME), "w") as f:
+        json.dump(meta, f)
+
+
+def loadQureg(path: str, env: QuESTEnv) -> Qureg:
+    """Restore a register saved by :func:`saveQureg` onto ``env``'s mesh.
+
+    The amplitude array is restored directly into the register's current
+    sharding (resharding on the fly if the mesh shape changed)."""
+    path = os.path.abspath(path)
+    meta_path = os.path.join(path, _META_NAME)
+    if not os.path.exists(meta_path):
+        raise QuESTError(f"no qureg checkpoint at {path}", "loadQureg")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    q = Qureg(
+        meta["num_qubits_represented"], env, meta["is_density_matrix"]
+    )
+    # restore in the checkpoint's dtype and keep the register
+    # self-consistent even if the global precision changed since save
+    q.dtype = np.dtype(meta["dtype"])
+    ckpt = _checkpointer()
+    target = jax.ShapeDtypeStruct(
+        (2, q.num_amps_total), np.dtype(meta["dtype"]), sharding=q.sharding()
+    )
+    restored = ckpt.restore(os.path.join(path, _AMPS_NAME), {"amps": target})
+    q.amps = restored["amps"]
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Reference-format CSV ("re, im" per line, '#' comments) — the format
+# reportState writes and initStateFromSingleFile reads in the reference.
+# ---------------------------------------------------------------------------
+
+
+def writeStateToFile(qureg: Qureg, filename: str) -> None:
+    """Dump amplitudes as reference-style CSV (QuEST_common.c:229-245)."""
+    amps = np.asarray(qureg.amps)
+    with open(filename, "w") as f:
+        f.write("# quest_tpu state dump: re, im per amplitude\n")
+        for k in range(amps.shape[1]):
+            f.write(f"{float(amps[0, k])!r}, {float(amps[1, k])!r}\n")
+
+
+def readStateFromFile(qureg: Qureg, filename: str) -> bool:
+    """Load amplitudes from reference-style CSV; returns success
+    (statevec_initStateFromSingleFile, QuEST_cpu.c:1680-1729)."""
+    if not os.path.exists(filename):
+        return False
+    re = np.zeros(qureg.num_amps_total)
+    im = np.zeros(qureg.num_amps_total)
+    k = 0
+    try:
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if k >= qureg.num_amps_total:
+                    break
+                parts = line.split(",")
+                re[k], im[k] = float(parts[0]), float(parts[1])
+                k += 1
+    except (ValueError, IndexError):
+        return False  # malformed line: report failure, leave state untouched
+    if k < qureg.num_amps_total:
+        return False  # truncated file
+    qureg.amps = qureg.device_put(np.stack([re, im]))
+    return True

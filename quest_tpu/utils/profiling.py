@@ -1,0 +1,51 @@
+"""Profiling hooks — a real tracing subsystem, beyond reference parity.
+
+The reference has no profiler at all (SURVEY.md §5.1: the only
+introspection is reportQuregParams / reportState).  quest_tpu wires the
+JAX/XLA profiler in as a first-class utility: traces capture kernel-level
+TPU timelines viewable in TensorBoard/Perfetto, and ``annotate`` marks
+circuit phases inside a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XLA device trace for the enclosed block::
+
+        with quest_tpu.utils.profiling.trace("/tmp/qt_trace"):
+            run_circuit()
+
+    Open the directory in TensorBoard (or xprof) to see per-kernel HBM/MXU
+    timelines."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def timed(label: str, sync: Optional[object] = None) -> Iterator[dict]:
+    """Wall-clock a block, blocking on ``sync`` (an array) if given; the
+    yielded dict gains {'seconds': ...} on exit."""
+    out: dict = {"label": label}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        if sync is not None:
+            jax.block_until_ready(sync)
+        out["seconds"] = time.perf_counter() - t0

@@ -1,0 +1,122 @@
+"""Patient TPU validation driver: waits for the chip claim, then times the
+fused Pallas path vs the per-gate einsum path and writes JSON results.
+
+Run in the background; progress prints are flushed so a tail shows where
+it is. Results land in scripts/tpu_validate_result.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tpu_validate_result.json")
+
+
+def log(*a):
+    print(f"[{time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def main():
+    log("importing jax ...")
+    import jax
+
+    log("waiting for device claim (may block for a long time) ...")
+    t0 = time.time()
+    devs = jax.devices()
+    log(f"claim granted after {time.time()-t0:.0f}s: {devs}")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.ops import cplx, fused, kernels
+    from quest_tpu import circuit as C
+
+    results = {"devices": str(devs)}
+    rng = np.random.default_rng(0)
+
+    def ru(k):
+        d = 1 << k
+        a = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
+        q, r = np.linalg.qr(a)
+        return q * (np.diag(r) / np.abs(np.diag(r)))
+
+    # -- step 1: tiny pallas compile (n=14, one grid step) --
+    log("compiling fused kernel at n=14 ...")
+    A = jnp.asarray(cplx.soa(ru(7)), jnp.float32)
+    B = jnp.asarray(cplx.soa(ru(7)), jnp.float32)
+    amps = jnp.zeros((2, 1 << 14), jnp.float32).at[0, 0].set(1.0)
+    t0 = time.time()
+    out = fused.apply_cluster_pair(amps, A, B, num_qubits=14, interpret=False)
+    out[0, 0].block_until_ready()
+    results["compile_n14_s"] = time.time() - t0
+    log(f"n=14 fused compile+run: {results['compile_n14_s']:.1f}s")
+
+    # correctness check vs einsum path at n=14
+    amps0 = rng.standard_normal((2, 1 << 14)).astype(np.float32)
+    amps0 /= np.sqrt((amps0 ** 2).sum())
+    got = np.asarray(fused.apply_cluster_pair(
+        jnp.asarray(amps0), A, B, num_qubits=14, interpret=False))
+    ref = jnp.asarray(amps0)
+    ref = kernels.apply_matrix(ref, A, num_qubits=14, targets=(0, 1, 2, 3, 4, 5, 6))
+    ref = kernels.apply_matrix(ref, B, num_qubits=14,
+                               targets=(7, 8, 9, 10, 11, 12, 13))
+    err = float(np.abs(got - np.asarray(ref)).max())
+    results["n14_max_err"] = err
+    log(f"n=14 fused-vs-einsum max err: {err:.2e}")
+
+    # -- step 2: n=26 timings --
+    n = 26
+    log("compiling fused kernel at n=26 ...")
+    amps = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+    t0 = time.time()
+    amps = fused.apply_cluster_pair(amps, A, B, num_qubits=n, interpret=False)
+    amps[0, 0].block_until_ready()
+    results["compile_n26_s"] = time.time() - t0
+    log(f"n=26 fused compile+run: {results['compile_n26_s']:.1f}s")
+
+    t0 = time.time()
+    for _ in range(10):
+        amps = fused.apply_cluster_pair(amps, A, B, num_qubits=n, interpret=False)
+    amps[0, 0].block_until_ready()
+    dt = (time.time() - t0) / 10
+    results["fused_pass_n26_ms"] = dt * 1e3
+    results["fused_pass_n26_gbps"] = 2 * 2 * (1 << n) * 4 / dt / 1e9
+    log(f"n=26 fused pass: {dt*1e3:.2f} ms ({results['fused_pass_n26_gbps']:.0f} GB/s r+w)")
+
+    # single 1q gate via einsum path (one HBM pass per gate)
+    u1 = jnp.asarray(cplx.soa(ru(1)), jnp.float32)
+    log("compiling single 1q gate at n=26 ...")
+    amps = kernels.apply_matrix(amps, u1, num_qubits=n, targets=(3,))
+    amps[0, 0].block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        amps = kernels.apply_matrix(amps, u1, num_qubits=n, targets=(3,))
+    amps[0, 0].block_until_ready()
+    dt1 = (time.time() - t0) / 10
+    results["gate_1q_n26_ms"] = dt1 * 1e3
+    log(f"n=26 single 1q gate: {dt1*1e3:.2f} ms -> fused does 14 qubits in "
+        f"{results['fused_pass_n26_ms']:.2f} ms ({14*dt1*1e3/results['fused_pass_n26_ms']:.1f}x)")
+
+    # permute pass
+    perm = tuple(list(range(12, 26)) + list(range(12)))
+    log("compiling permute at n=26 ...")
+    p = kernels.permute_qubits(amps, num_qubits=n, perm=perm)
+    p[0, 0].block_until_ready()
+    t0 = time.time()
+    for _ in range(10):
+        p = kernels.permute_qubits(p, num_qubits=n, perm=perm)
+    p[0, 0].block_until_ready()
+    results["permute_pass_n26_ms"] = (time.time() - t0) / 10 * 1e3
+    log(f"n=26 permute pass: {results['permute_pass_n26_ms']:.2f} ms")
+
+    with open(RESULT_PATH, "w") as f:
+        json.dump(results, f, indent=1)
+    log("results written to", RESULT_PATH)
+
+
+if __name__ == "__main__":
+    main()

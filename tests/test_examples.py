@@ -1,0 +1,45 @@
+"""Smoke-run the examples/ programs (the reference ships and documents its
+demos as part of the library surface, examples/README.md)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, env_extra=None):
+    env = dict(os.environ)
+    env["QT_EXAMPLES_CPU"] = "1"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+
+
+def test_tutorial():
+    r = _run("tutorial_example.py")
+    assert r.returncode == 0, r.stderr
+    assert "Probability amplitude of |111>" in r.stdout
+
+
+def test_bernstein_vazirani():
+    r = _run("bernstein_vazirani.py")
+    assert r.returncode == 0, r.stderr
+    assert "recovered = 17" in r.stdout
+
+
+@pytest.mark.parametrize("mode", [[], ["--fused"]])
+def test_grover(mode):
+    r = _run("grovers_search.py", *mode, env_extra={"QT_GROVER_QUBITS": "7"})
+    assert r.returncode == 0, r.stderr
+    assert "prob of solution" in r.stdout
+
+
+def test_vqe_train():
+    r = _run("vqe_train.py", env_extra={"QT_VQE_QUBITS": "6"})
+    assert r.returncode == 0, r.stderr
+    assert "done; final energy" in r.stdout
