@@ -1,0 +1,29 @@
+# Build targets for the native runtime pieces and the test/bench entry
+# points. The Python package itself needs no build step; the native
+# scheduler also auto-builds on first import (quest_tpu/native/__init__.py)
+# — this Makefile is the explicit path.
+
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
+
+NATIVE_DIR := quest_tpu/native
+NATIVE_SO := $(NATIVE_DIR)/_qts.so
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_DIR)/scheduler.cc
+	$(CXX) $(CXXFLAGS) -shared $< -o $@
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(NATIVE_SO)
+	find . -name __pycache__ -type d -exec rm -rf {} +

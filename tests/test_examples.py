@@ -43,3 +43,9 @@ def test_vqe_train():
     r = _run("vqe_train.py", env_extra={"QT_VQE_QUBITS": "6"})
     assert r.returncode == 0, r.stderr
     assert "done; final energy" in r.stdout
+
+
+def test_qaoa_maxcut():
+    r = _run("qaoa_maxcut.py", env_extra={"QT_QAOA_QUBITS": "6"})
+    assert r.returncode == 0, r.stderr
+    assert "expected cut" in r.stdout

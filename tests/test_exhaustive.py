@@ -1,0 +1,146 @@
+"""Exhaustive target/control sweeps — the reference's GENERATE-everything
+discipline (test_unitaries.cpp SECTIONs enumerate every target and every
+control sublist on 5 qubits; utilities.hpp:1054-1182 custom generators).
+
+These complement the spot-parametrized files: every (target, control)
+geometry of the workhorse ops runs against the dense oracle, on psi AND
+rho, in one sweep per op family."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+import oracle
+from test_unitaries import check_gate
+
+N = 5
+
+
+def _u(rng, k):
+    return oracle.random_unitary(k, rng)
+
+
+class TestUnitaryAllGeometries:
+    def test_unitary_every_target(self, env):
+        rng = np.random.default_rng(20)
+        for t in range(N):
+            u = _u(rng, 1)
+            check_gate(env, lambda q: qt.unitary(q, t, u), [t], u)
+
+    def test_controlled_unitary_every_pair(self, env):
+        rng = np.random.default_rng(21)
+        for c, t in itertools.permutations(range(N), 2):
+            u = _u(rng, 1)
+            check_gate(
+                env, lambda q: qt.controlledUnitary(q, c, t, u), [t], u, [c]
+            )
+
+    def test_two_qubit_unitary_every_pair(self, env):
+        rng = np.random.default_rng(22)
+        for t1, t2 in itertools.permutations(range(N), 2):
+            u = _u(rng, 2)
+            check_gate(
+                env, lambda q: qt.twoQubitUnitary(q, t1, t2, u), [t1, t2], u
+            )
+
+    def test_multi_qubit_unitary_every_triple(self, env):
+        rng = np.random.default_rng(23)
+        for targs in itertools.permutations(range(N), 3):
+            u = _u(rng, 3)
+            check_gate(
+                env,
+                lambda q: qt.multiQubitUnitary(q, list(targs), u),
+                list(targs), u,
+            )
+
+    def test_multi_controlled_unitary_every_control_subset(self, env):
+        rng = np.random.default_rng(24)
+        for t in range(N):
+            others = [q for q in range(N) if q != t]
+            for r in range(1, len(others) + 1):
+                for ctrls in itertools.combinations(others, r):
+                    u = _u(rng, 1)
+                    check_gate(
+                        env,
+                        lambda q: qt.multiControlledUnitary(q, list(ctrls), t, u),
+                        [t], u, list(ctrls),
+                    )
+
+    def test_multi_state_controlled_every_state_pattern(self, env):
+        rng = np.random.default_rng(25)
+        t = 2
+        ctrls = [0, 4]
+        for states in itertools.product([0, 1], repeat=2):
+            u = _u(rng, 1)
+            check_gate(
+                env,
+                lambda q: qt.multiStateControlledUnitary(
+                    q, list(ctrls), list(states), t, u
+                ),
+                [t], u, list(ctrls), list(states),
+            )
+
+    def test_mcmq_unitary_geometries(self, env):
+        rng = np.random.default_rng(26)
+        for targs in itertools.combinations(range(N), 2):
+            rest = [q for q in range(N) if q not in targs]
+            for ctrls in itertools.combinations(rest, 2):
+                u = _u(rng, 2)
+                check_gate(
+                    env,
+                    lambda q: qt.multiControlledMultiQubitUnitary(
+                        q, list(ctrls), list(targs), u
+                    ),
+                    list(targs), u, list(ctrls),
+                )
+
+
+class TestPhaseGeometries:
+    def test_phase_shift_every_target(self, env):
+        for t in range(N):
+            theta = 0.37 + t
+            m = np.diag([1.0, np.exp(1j * theta)])
+            check_gate(env, lambda q: qt.phaseShift(q, t, theta), [t], m)
+
+    def test_controlled_phase_flip_every_pair(self, env):
+        m = np.diag([1.0, -1.0]).astype(complex)
+        for a, b in itertools.combinations(range(N), 2):
+            check_gate(env, lambda q: qt.controlledPhaseFlip(q, a, b), [b],
+                       m, [a])
+
+    def test_multi_rotate_z_every_subset(self, env):
+        for r in range(1, N + 1):
+            for qs in itertools.combinations(range(N), r):
+                theta = 0.21 * r
+                # oracle: exp(-i theta/2 Z x..x Z) on the subset
+                d = np.ones(1, dtype=complex)
+                zz = np.array([1.0, -1.0])
+                par = np.zeros(2 ** r)
+                idx = np.arange(2 ** r)
+                for b in range(r):
+                    par += (idx >> b) & 1
+                d = np.exp(-0.5j * theta * (-1.0) ** par)
+                check_gate(
+                    env, lambda q: qt.multiRotateZ(q, list(qs), theta),
+                    list(qs), np.diag(d),
+                )
+
+
+class TestMeasurementGeometries:
+    @pytest.mark.parametrize("target", range(N))
+    @pytest.mark.parametrize("outcome", [0, 1])
+    def test_prob_and_collapse_every_target(self, env, target, outcome):
+        psi = qt.createQureg(N, env)
+        qt.initDebugState(psi)
+        state = oracle.debug_state(2 ** N)
+        idx = np.arange(2 ** N)
+        mask = ((idx >> target) & 1) == outcome
+        p_ref = float(np.sum(np.abs(state[mask]) ** 2))
+        assert abs(qt.calcProbOfOutcome(psi, target, outcome) - p_ref) < 1e-10
+        qt.collapseToOutcome(psi, target, outcome)
+        ref = np.where(mask, state, 0.0) / np.sqrt(p_ref)
+        np.testing.assert_allclose(
+            oracle.state_from_qureg(psi), ref, atol=1e-10
+        )
