@@ -99,14 +99,18 @@ def main():
     amps = kernels.init_zero_state(1 << N, np.float32)
     # warm-up (compile)
     amps, prob = jprog(amps, unitaries)
-    prob.block_until_ready()
+    float(prob)
 
     times = []
     for _ in range(REPS):
         amps = kernels.init_zero_state(1 << N, np.float32)
+        float(np.asarray(amps[0, 0]))  # sync before starting the clock
         t0 = time.perf_counter()
         amps, prob = jprog(amps, unitaries)
-        prob.block_until_ready()
+        # device-to-host fetch: under the axon relay block_until_ready
+        # returns at enqueue time, so only a materialization bounds the
+        # full execution
+        float(prob)
         times.append(time.perf_counter() - t0)
 
     best = min(times)

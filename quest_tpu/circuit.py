@@ -9,10 +9,11 @@ This module is the TPU-native replacement for that dispatch loop: a
                               unitaries (ops/fused.py Pallas kernel)
     ('apply',   targets, mat) fallback standard kernel (cluster-spanning
                               gates, e.g. a CNOT across the 6/7 boundary)
-    ('permute', perm)         one-pass qubit relabel pulling upcoming high
-                              targets into the cluster window — the
-                              single-chip analogue of the reference's
-                              distributed SWAP-relocalization
+    ('segswap', a, b, m)      exchange bit segments [a,a+m) <-> [b,b+m):
+                              pulls a whole 7-bit page of high qubits into
+                              the sublane window as ONE tile-aligned
+                              transpose — the single-chip analogue of the
+                              reference's distributed SWAP-relocalization
                               (QuEST_cpu_distributed.c:1503-1545)
 
 Planning is pure Python over *static* gate structure (targets), so it runs
@@ -26,6 +27,7 @@ uses it when the native library is built (see native/__init__.py).
 
 from __future__ import annotations
 
+import itertools
 import math
 from dataclasses import dataclass
 from functools import lru_cache
@@ -40,6 +42,7 @@ from .ops import cplx, fused, kernels
 LANE = fused.LANE_QUBITS            # 7
 WINDOW = fused.CLUSTER_QUBITS       # 14
 DIM = fused.CLUSTER_DIM             # 128
+_LOOKAHEAD = 256                    # next-use horizon for eviction choice
 
 
 @dataclass(frozen=True)
@@ -135,6 +138,9 @@ class _Plan:
         self.accA = None  # traced (2,128,128) or None
         self.accB = None
         self.count = 0  # gates folded since last flush
+        # segment length for relocation swaps (page size)
+        self.seg = min(LANE, max(0, num_qubits - WINDOW))
+        self.swap_stack: List[Tuple[int, int]] = []  # (h, b) per segswap
 
     def _fold(self, cluster: str, bits: Tuple[int, ...], mat):
         e = embed_in_cluster(mat, bits)
@@ -156,38 +162,74 @@ class _Plan:
         self.accA = self.accB = None
         self.count = 0
 
-    def permute_for(self, working_set: Sequence[int]):
-        """Emit a relabel placing ``working_set`` (physical positions, first-
-        use order) into the low window.  Positions already < WINDOW keep
-        their slot when possible; high ones displace low positions that are
-        NOT in the working set."""
+    def _emit_segswap(self, h: int, b: int):
+        """Exchange bit segments [h, h+seg) <-> [b, b+seg)."""
+        m = self.seg
         self.flush()
-        n = self.n
-        ws = list(dict.fromkeys(working_set))[: min(WINDOW, n)]
-        high = [p for p in ws if p >= WINDOW]
+        self.ops.append(("segswap", h, b, m))
+        newpos = []
+        for p in self.pos:
+            if b <= p < b + m:
+                newpos.append(h + (p - b))
+            elif h <= p < h + m:
+                newpos.append(b + (p - h))
+            else:
+                newpos.append(p)
+        self.pos = newpos
+
+    def page_in(self, phys: Sequence[int], future_targets) -> bool:
+        """Try one segment swap making ``phys`` window-coverable: pull the
+        page containing all high positions into the sublane window — the
+        TPU-native analogue of the reference's per-qubit SWAP-relocalization
+        (QuEST_cpu_distributed.c:1503-1545), but page-granular so the move
+        is a tile-aligned transpose (kernels.swap_bit_segments).
+
+        The evicted window page [b, b+seg) is chosen by lookahead: the
+        candidate whose current occupants are needed furthest in the future
+        (``future_targets`` = iterator of upcoming logical targets)."""
+        m = self.seg
+        if m <= 0:
+            return False
+        high = [p for p in phys if p >= WINDOW]
         if not high:
-            return
-        ws_set = set(ws)
-        free_low = [p for p in range(min(WINDOW, n)) if p not in ws_set]
-        # perm[new_position] = old_position
-        perm = list(range(n))
-        for p in high:
-            f = free_low.pop(0)
-            perm[f], perm[p] = p, f
-        self.ops.append(("permute", tuple(perm)))
-        # update logical->physical: logical q at old position perm[new] is
-        # now at new position
-        old_to_new = {old: new for new, old in enumerate(perm)}
-        self.pos = [old_to_new[p] for p in self.pos]
+            return False
+        lo_h = max(WINDOW, max(high) - m + 1)
+        hi_h = min(self.n - m, min(high))
+        if lo_h > hi_h:
+            return False
+        h = hi_h
+        # candidate eviction pages: must not contain this gate's own
+        # window-resident targets
+        lowpos = set(p for p in phys if p < WINDOW)
+        cands = [b for b in range(LANE, WINDOW - m + 1)
+                 if not any(b <= p < b + m for p in lowpos)]
+        if not cands:
+            return False
+        if len(cands) > 1:
+            # next-use distance of each position (capped horizon)
+            next_use = {}
+            for d, t in enumerate(future_targets):
+                p = self.pos[t]
+                if p not in next_use:
+                    next_use[p] = d
+                if d >= _LOOKAHEAD:
+                    break
+            def score(b):
+                return min((next_use.get(p, _LOOKAHEAD + 1)
+                            for p in range(b, b + m)), default=0)
+            b = max(cands, key=lambda c: (score(c), -c))
+        else:
+            b = cands[0]
+        self._emit_segswap(h, b)
+        self.swap_stack.append((h, b))
+        return True
 
     def final_restore(self):
         self.flush()
-        if self.pos != list(range(self.n)):
-            # physical position p holds logical self.pos^{-1}[p]; emit the
-            # relabel putting logical q back at position q:
-            # perm[new=q] = old position of logical q = pos[q]
-            self.ops.append(("permute", tuple(self.pos)))
-            self.pos = list(range(self.n))
+        for h, b in reversed(self.swap_stack):
+            self._emit_segswap(h, b)
+        self.swap_stack = []
+        assert self.pos == list(range(self.n))
 
 
 def _cluster_of(phys: Sequence[int]) -> Optional[str]:
@@ -237,7 +279,8 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
 
 
 def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
-    """Greedy one-pass scheduler with first-use lookahead for permutations."""
+    """Greedy one-pass scheduler: fold into clusters, page-swap high bits
+    into the sublane window, standard-kernel fallback for the rest."""
     n = num_qubits
     if n < WINDOW:
         # Too small for the cluster kernel: program = plain per-gate applies.
@@ -245,6 +288,11 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
 
     plan = _Plan(n)
     glist = list(gates)
+
+    def future(gi):
+        for gg in itertools.islice(glist, gi, None):
+            yield from gg.targets
+
     for gi, g in enumerate(glist):
         phys = tuple(plan.pos[t] for t in g.targets)
         cl = _cluster_of(phys)
@@ -252,29 +300,16 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
             bits = tuple(p if cl == "A" else p - LANE for p in phys)
             plan._fold(cl, bits, g.mat)
             continue
-        if all(p < WINDOW for p in phys):
-            # spans both clusters: flush, apply via the standard kernel
-            plan.flush()
-            plan.ops.append(("apply", phys, g.mat))
-            continue
-        # high target: permute the upcoming working set into the window
-        ws: List[int] = []
-        for h in glist[gi:]:
-            for t in h.targets:
-                p = plan.pos[t]
-                if p not in ws:
-                    ws.append(p)
-            if len(ws) >= WINDOW:
-                break
-        plan.permute_for(ws)
-        phys = tuple(plan.pos[t] for t in g.targets)
-        cl = _cluster_of(phys)
-        if cl is not None:
-            bits = tuple(p if cl == "A" else p - LANE for p in phys)
-            plan._fold(cl, bits, g.mat)
-        else:
-            plan.flush()
-            plan.ops.append(("apply", phys, g.mat))
+        if any(p >= WINDOW for p in phys) and plan.page_in(phys, future(gi)):
+            phys = tuple(plan.pos[t] for t in g.targets)
+            cl = _cluster_of(phys)
+            if cl is not None:
+                bits = tuple(p if cl == "A" else p - LANE for p in phys)
+                plan._fold(cl, bits, g.mat)
+                continue
+        # cross-cluster or un-pageable: standard layout-safe kernel
+        plan.flush()
+        plan.ops.append(("apply", phys, g.mat))
     plan.final_restore()
     return plan.ops
 
@@ -292,6 +327,10 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
             amps = kernels.apply_matrix(
                 amps, jnp.asarray(op[2], amps.dtype), num_qubits=n,
                 targets=tuple(op[1]),
+            )
+        elif op[0] == "segswap":
+            amps = kernels.swap_bit_segments(
+                amps, num_qubits=n, a=op[1], b=op[2], m=op[3]
             )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
@@ -313,4 +352,5 @@ def stats(ops: Sequence[tuple]) -> dict:
 
     c = Counter(op[0] for op in ops)
     return {"fused": c.get("fused", 0), "apply": c.get("apply", 0),
-            "permute": c.get("permute", 0), "total_passes": sum(c.values())}
+            "segswap": c.get("segswap", 0), "permute": c.get("permute", 0),
+            "total_passes": sum(c.values())}

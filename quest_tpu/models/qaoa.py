@@ -9,9 +9,8 @@ program.
 TPU fit: the cost layer e^{-i gamma C} for a diagonal cost C is a pure
 elementwise multiply (no amplitude pairing at all), and the cost
 expectation is an elementwise reduce — both stream at HBM bandwidth. The
-cost vector is built lazily in-graph from per-edge (2,2) XOR tables
-broadcast over the (2,)*n amplitude view, so no host-side 2^n table is
-materialized or transferred.
+cost vector is built lazily in-graph from iota bit arithmetic, so no
+host-side 2^n table is materialized or transferred.
 """
 
 from __future__ import annotations
@@ -26,8 +25,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..env import AMP_AXIS
 from ..ops import kernels
-
-_XOR = np.array([[0.0, 1.0], [1.0, 0.0]])
 
 
 class QAOA:
@@ -53,20 +50,14 @@ class QAOA:
     def init_params(self, key) -> jax.Array:
         return 0.1 * jax.random.normal(key, (self.num_params,))
 
-    def _cost_view(self, dtype):
-        """Cut-size c(z) broadcast over the (2,)*n basis view (no channel
-        axis); built from per-edge XOR tables, accumulated in-graph."""
+    def _cost_2d(self, dtype):
+        """Cut-size c(z) as a (2^hi, 2^lo) array built from iota bit views
+        (kernels.bit_2d: XLA fuses the per-edge XOR chain into the consuming
+        multiply; no host-side 2^n table and no high-rank broadcast)."""
         n = self.num_qubits
-        c = jnp.zeros((1,) * n, dtype=dtype)
+        c = jnp.zeros((1, 1), dtype=dtype)
         for i, j, w in self.edges:
-            shape = [1] * n
-            shape[n - 1 - i] = 2
-            bi = jnp.asarray(np.array([0.0, 1.0]), dtype).reshape(shape)
-            shape = [1] * n
-            shape[n - 1 - j] = 2
-            bj = jnp.asarray(np.array([0.0, 1.0]), dtype).reshape(shape)
-            # XOR of two {0,1} bits: b_i + b_j - 2 b_i b_j
-            c = c + w * (bi + bj - 2.0 * bi * bj)
+            c = c + w * (kernels.bit_2d(n, i) ^ kernels.bit_2d(n, j)).astype(dtype)
         return c
 
     def state(self, params):
@@ -77,12 +68,13 @@ class QAOA:
             amps = lax.with_sharding_constraint(
                 amps, NamedSharding(self.mesh, P(None, AMP_AXIS))
             )
-        cost = self._cost_view(params.dtype)
+        cost = self._cost_2d(params.dtype)
+        hi, lo = kernels._split2(n)
         p = params.reshape(self.depth, 2)
         for layer in range(self.depth):
             gamma, beta = p[layer, 0], p[layer, 1]
             # cost phase: elementwise exp(-i gamma c(z))
-            view = amps.reshape((2,) + (2,) * n)
+            view = amps.reshape(2, 1 << hi, 1 << lo)
             ang = -gamma * cost
             re = view[0] * jnp.cos(ang) - view[1] * jnp.sin(ang)
             im = view[0] * jnp.sin(ang) + view[1] * jnp.cos(ang)
@@ -102,9 +94,9 @@ class QAOA:
     def expected_cut(self, params):
         """<psi| C |psi> — the quantity QAOA maximises."""
         amps = self.state(params)
-        n = self.num_qubits
-        cost = self._cost_view(params.dtype)
-        view = amps.reshape((2,) + (2,) * n)
+        cost = self._cost_2d(params.dtype)
+        hi, lo = kernels._split2(self.num_qubits)
+        view = amps.reshape(2, 1 << hi, 1 << lo)
         probs = view[0] * view[0] + view[1] * view[1]
         return jnp.sum(probs * cost)
 

@@ -89,7 +89,7 @@ def plan_native(target_lists: Sequence[Sequence[int]],
     Returns a *structural* plan — ops referencing gates by index:
       ('fused', [(gate_idx, bits), ...A], [(gate_idx, bits), ...B])
       ('apply', gate_idx, phys_targets)
-      ('permute', perm)
+      ('segswap', a, b, m)
     or None when the native library is unavailable.
     """
     lib = get_lib()
@@ -142,6 +142,9 @@ def plan_native(target_lists: Sequence[Sequence[int]],
             k = int(data[i]); i += 1
             perm = tuple(int(p) for p in data[i:i + k]); i += k
             ops.append(("permute", perm))
+        elif kind == 3:
+            a = int(data[i]); b = int(data[i + 1]); m = int(data[i + 2]); i += 3
+            ops.append(("segswap", a, b, m))
         else:
             raise ValueError(f"bad plan op kind {kind}")
     return ops

@@ -23,17 +23,23 @@
 //     kind 0 (fused):   0, nA, {gate_idx, k, bits[k]} * nA,
 //                          nB, {gate_idx, k, bits[k]} * nB
 //     kind 1 (apply):   1, gate_idx, k, phys_targets[k]
-//     kind 2 (permute): 2, n, perm[n]       (perm[new_pos] = old_pos)
+//     kind 2 (permute): 2, n, perm[n]       (perm[new_pos] = old_pos; legacy)
+//     kind 3 (segswap): 3, a, b, m          (swap bit segments [a,a+m) and
+//                                            [b,b+m); see
+//                                            kernels.swap_bit_segments)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <utility>
 #include <vector>
 
 namespace {
 
 constexpr int kLane = 7;     // qubits 0..6  -> lane cluster A
 constexpr int kWindow = 14;  // qubits 0..13 -> the fused window
+constexpr int64_t kLookahead = 256;  // next-use horizon for eviction choice
 
 struct Fold {
   int64_t gate;
@@ -45,9 +51,15 @@ struct Plan {
   int64_t num_ops = 0;
   std::vector<int64_t> pos;  // pos[logical] = physical
   std::vector<Fold> accA, accB;
+  int64_t n;
+  int64_t seg;                       // relocation page size
+  std::vector<std::pair<int64_t, int64_t>> swap_stack;  // (h, b) per segswap
 
-  explicit Plan(int64_t n) : pos(n) {
+  explicit Plan(int64_t n_) : pos(n_), n(n_) {
     for (int64_t q = 0; q < n; ++q) pos[q] = q;
+    seg = n - kWindow;
+    if (seg > kLane) seg = kLane;
+    if (seg < 0) seg = 0;
   }
 
   void flush() {
@@ -66,15 +78,26 @@ struct Plan {
     ++num_ops;
   }
 
-  void emit_permute(const std::vector<int64_t>& perm) {
-    buf.push_back(2);
-    buf.push_back(static_cast<int64_t>(perm.size()));
-    buf.insert(buf.end(), perm.begin(), perm.end());
+  void emit_segswap(int64_t h, int64_t b) {
+    flush();
+    buf.push_back(3);
+    buf.push_back(h);
+    buf.push_back(b);
+    buf.push_back(seg);
     ++num_ops;
-    // content of old position perm[new] lands at new; update logical map
-    std::vector<int64_t> old_to_new(perm.size());
-    for (size_t np = 0; np < perm.size(); ++np) old_to_new[perm[np]] = np;
-    for (auto& p : pos) p = old_to_new[p];
+    for (auto& p : pos) {
+      if (p >= b && p < b + seg)
+        p = h + (p - b);
+      else if (p >= h && p < h + seg)
+        p = b + (p - h);
+    }
+  }
+
+  void final_restore() {
+    flush();
+    for (auto it = swap_stack.rbegin(); it != swap_stack.rend(); ++it)
+      emit_segswap(it->first, it->second);
+    swap_stack.clear();
   }
 
   void emit_apply(int64_t gate, const std::vector<int64_t>& phys) {
@@ -123,6 +146,58 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
     return phys;
   };
 
+  // Mirrors _Plan.page_in in circuit.py (identical plans asserted by
+  // tests/test_circuit.py): one segment swap pulling the page containing
+  // all high positions of phys into the sublane window, evicting the page
+  // whose occupants are needed furthest in the future.
+  auto page_in = [&](int64_t g, const std::vector<int64_t>& phys) -> bool {
+    const int64_t m = plan.seg;
+    if (m <= 0) return false;
+    int64_t hmin = -1, hmax = -1;
+    for (int64_t p : phys)
+      if (p >= kWindow) {
+        if (hmin < 0 || p < hmin) hmin = p;
+        if (p > hmax) hmax = p;
+      }
+    if (hmin < 0) return false;
+    int64_t lo_h = std::max<int64_t>(kWindow, hmax - m + 1);
+    int64_t hi_h = std::min<int64_t>(n - m, hmin);
+    if (lo_h > hi_h) return false;
+    const int64_t h = hi_h;
+    std::vector<int64_t> cands;
+    for (int64_t b = kLane; b <= kWindow - m; ++b) {
+      bool ok = true;
+      for (int64_t p : phys)
+        if (p < kWindow && p >= b && p < b + m) ok = false;
+      if (ok) cands.push_back(b);
+    }
+    if (cands.empty()) return false;
+    int64_t best = cands[0];
+    if (cands.size() > 1) {
+      std::vector<int64_t> next_use(n, kLookahead + 1);
+      int64_t d = 0;
+      for (int64_t gg = g; gg < num_gates && d <= kLookahead; ++gg)
+        for (int64_t i = offsets[gg]; i < offsets[gg + 1] && d <= kLookahead;
+             ++i, ++d) {
+          int64_t p = plan.pos[targets[i]];
+          if (next_use[p] > d) next_use[p] = d;
+        }
+      int64_t best_score = -1;
+      for (int64_t b : cands) {
+        int64_t score = kLookahead + 1;
+        for (int64_t p = b; p < b + m; ++p)
+          score = std::min(score, next_use[p]);
+        if (score > best_score) {
+          best_score = score;
+          best = b;
+        }
+      }
+    }
+    plan.emit_segswap(h, best);
+    plan.swap_stack.emplace_back(h, best);
+    return true;
+  };
+
   if (n < kWindow) {
     // too small for the cluster kernel: plain per-gate applies
     for (int64_t g = 0; g < num_gates; ++g) plan.emit_apply(g, phys_of(g));
@@ -134,59 +209,21 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
         fold(plan, cl, g, phys);
         continue;
       }
-      bool in_window = true;
-      for (int64_t p : phys) in_window = in_window && p < kWindow;
-      if (in_window) {
-        plan.flush();
-        plan.emit_apply(g, phys);
-        continue;
-      }
-      // high target: gather the upcoming working set (first-use order)
-      std::vector<int64_t> ws;
-      for (int64_t h = g; h < num_gates && (int64_t)ws.size() < kWindow; ++h) {
-        for (int64_t i = offsets[h]; i < offsets[h + 1]; ++i) {
-          int64_t p = plan.pos[targets[i]];
-          bool seen = false;
-          for (int64_t w : ws) seen = seen || (w == p);
-          if (!seen) ws.push_back(p);
+      bool has_high = false;
+      for (int64_t p : phys) has_high = has_high || p >= kWindow;
+      if (has_high && page_in(g, phys)) {
+        phys = phys_of(g);
+        cl = cluster_of(phys);
+        if (cl >= 0) {
+          fold(plan, cl, g, phys);
+          continue;
         }
       }
-      if ((int64_t)ws.size() > (n < kWindow ? n : (int64_t)kWindow))
-        ws.resize(kWindow);
+      // cross-cluster or un-pageable: standard layout-safe kernel
       plan.flush();
-      std::vector<int64_t> high;
-      for (int64_t p : ws)
-        if (p >= kWindow) high.push_back(p);
-      if (!high.empty()) {
-        std::vector<bool> in_ws(n, false);
-        for (int64_t p : ws) in_ws[p] = true;
-        std::vector<int64_t> free_low;
-        for (int64_t p = 0; p < kWindow; ++p)
-          if (!in_ws[p]) free_low.push_back(p);
-        std::vector<int64_t> perm(n);
-        for (int64_t p = 0; p < n; ++p) perm[p] = p;
-        size_t fi = 0;
-        for (int64_t p : high) {
-          int64_t f = free_low[fi++];
-          perm[f] = p;
-          perm[p] = f;
-        }
-        plan.emit_permute(perm);
-      }
-      phys = phys_of(g);
-      cl = cluster_of(phys);
-      if (cl >= 0) {
-        fold(plan, cl, g, phys);
-      } else {
-        plan.flush();
-        plan.emit_apply(g, phys);
-      }
+      plan.emit_apply(g, phys);
     }
-    plan.flush();
-    // restore logical order: perm[new=q] = pos[q]
-    bool identity = true;
-    for (int64_t q = 0; q < n; ++q) identity = identity && plan.pos[q] == q;
-    if (!identity) plan.emit_permute(plan.pos);
+    plan.final_restore();
   }
   plan.flush();
 

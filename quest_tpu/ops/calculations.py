@@ -17,6 +17,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import cplx
 
@@ -50,11 +51,12 @@ def calc_total_prob_density(amps, *, num_qubits: int):
 @partial(jax.jit, static_argnames=("num_qubits", "target", "outcome"))
 def calc_prob_of_outcome_statevec(amps, *, num_qubits: int, target: int, outcome: int):
     """(statevec_calcProbOfOutcome, QuEST_cpu.c:3418-3508)."""
+    from .kernels import bit_indicator_2d
+
     n = num_qubits
-    view = amps.reshape((2,) + (2,) * n)
-    sel = [slice(None)] * (n + 1)
-    sel[_axis(n, target)] = outcome
-    return jnp.sum(cplx.abs2(view[tuple(sel)]))
+    ind = bit_indicator_2d(n, ((target, outcome),), amps.dtype)
+    view = amps.reshape(2, ind.shape[0], ind.shape[1])
+    return jnp.sum(cplx.abs2(view) * ind)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "target", "outcome"))
@@ -62,34 +64,71 @@ def calc_prob_of_outcome_density(amps, *, num_qubits: int, target: int, outcome:
     """Sum of diagonal rho elements whose target bit equals outcome
     (densmatr_calcProbOfOutcome via findProbabilityOfZero,
     QuEST_cpu.c:3363-3417)."""
+    from .kernels import bit_indicator_2d
+
     n = num_qubits
-    diag_re = _diag(amps, num_qubits)[0].reshape((2,) * n)
-    sel = [slice(None)] * n
-    sel[n - 1 - target] = outcome
-    return jnp.sum(diag_re[tuple(sel)])
+    diag_re = _diag(amps, num_qubits)[0]
+    ind = bit_indicator_2d(n, ((target, outcome),), amps.dtype)
+    return jnp.sum(diag_re.reshape(ind.shape) * ind)
+
+
+def _outcome_histogram(vals, n: int, qubits: Tuple[int, ...]):
+    """sum vals over amps grouped by the bits of ``qubits`` (outcome index
+    bit j <-> qubits[j]): hist = A_hi^T (V A_lo) with {0,1} indicator
+    matrices built from iotas — two MXU matmuls, no scatter (the reference
+    uses an omp-atomic scatter, QuEST_cpu.c:3510-3574) and no small-minor
+    reshape."""
+    from ..utils import bits as bits_mod
+    from .kernels import _split2
+
+    k = len(qubits)
+    hi, lo = _split2(n)
+    qlo = [q for q in qubits if q < lo]
+    qhi = [q for q in qubits if q >= lo]
+    ilo = jax.lax.iota(jnp.int32, 1 << lo)
+    ihi = jax.lax.iota(jnp.int32, 1 << hi)
+
+    def onehot(iota, qs, offset):
+        """(len(iota), 2^len(qs)) {0,1} indicator of the qs bit pattern."""
+        code = jnp.zeros_like(iota)
+        for j, q in enumerate(qs):
+            code = code + (bits_mod.bits_of(iota, q - offset) << j)
+        return (code[:, None] == jnp.arange(1 << len(qs))[None, :]).astype(vals.dtype)
+
+    a_lo = onehot(ilo, qlo, 0)          # (2^lo, 2^kl)
+    a_hi = onehot(ihi, qhi, lo)         # (2^hi, 2^kh)
+    v = vals.reshape(1 << hi, 1 << lo)
+    inner = jnp.matmul(v, a_lo, precision=jax.lax.Precision.HIGHEST)
+    hist2 = jnp.matmul(a_hi.T, inner,
+                       precision=jax.lax.Precision.HIGHEST)  # (2^kh, 2^kl)
+    # hist2[ch, cl]: ch bit j <-> qhi[j], cl bit j <-> qlo[j]; remap to the
+    # outcome convention (bit j <-> qubits[j]) with a tiny static gather.
+    hist_flat = hist2.reshape(-1)  # index = ch * 2^kl + cl
+    res = np.zeros(1 << k, dtype=np.int64)
+    for o in range(1 << k):
+        ch = 0
+        cl = 0
+        for j, q in enumerate(qubits):
+            bitv = (o >> j) & 1
+            if q < lo:
+                cl |= bitv << qlo.index(q)
+            else:
+                ch |= bitv << qhi.index(q)
+        res[o] = ch * (1 << len(qlo)) + cl
+    return hist_flat[jnp.asarray(res)]
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "qubits"))
 def calc_prob_of_all_outcomes_statevec(amps, *, num_qubits: int, qubits: Tuple[int, ...]):
     """2^k-outcome histogram; outcome index bit j <-> qubits[j]
     (calcProbOfAllOutcomes, QuEST_cpu.c:3510-3574 — the reference builds it
-    with an omp-atomic scatter; a transpose+reduce is the vectorized form)."""
-    n = num_qubits
-    k = len(qubits)
-    probs = cplx.abs2(amps).reshape((2,) * n)
-    axes = tuple(n - 1 - q for q in reversed(qubits))
-    moved = jnp.moveaxis(probs, axes, range(k))
-    return jnp.sum(moved.reshape(2 ** k, -1), axis=1)
+    with an omp-atomic scatter; a reshape+reduce is the vectorized form)."""
+    return _outcome_histogram(cplx.abs2(amps), num_qubits, qubits)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "qubits"))
 def calc_prob_of_all_outcomes_density(amps, *, num_qubits: int, qubits: Tuple[int, ...]):
-    n = num_qubits
-    k = len(qubits)
-    diag_re = _diag(amps, num_qubits)[0].reshape((2,) * n)
-    axes = tuple(n - 1 - q for q in reversed(qubits))
-    moved = jnp.moveaxis(diag_re, axes, range(k))
-    return jnp.sum(moved.reshape(2 ** k, -1), axis=1)
+    return _outcome_histogram(_diag(amps, num_qubits)[0], num_qubits, qubits)
 
 
 @jax.jit

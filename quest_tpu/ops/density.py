@@ -66,9 +66,9 @@ def mix_dephasing(amps, prob, *, num_qubits: int, target: int):
     SoA channels identically."""
     n = num_qubits
     nn = 2 * n
-    view = amps.reshape((2,) + (2,) * nn)
     prob = jnp.asarray(prob, amps.dtype)
-    sign = kernels.parity_sign(nn, (target, target + n), amps.dtype)
+    sign = kernels.parity_sign_2d(nn, (target, target + n), amps.dtype)
+    view = amps.reshape(2, sign.shape[0], sign.shape[1])
     factor = (1 - prob) + prob * sign
     return (view * factor[None]).reshape(2, -1)
 
@@ -79,10 +79,10 @@ def mix_two_qubit_dephasing(amps, prob, *, num_qubits: int, qubit1: int, qubit2:
     (densmatr_mixTwoQubitDephasing, QuEST_cpu.c:92-123)."""
     n = num_qubits
     nn = 2 * n
-    view = amps.reshape((2,) + (2,) * nn)
     prob = jnp.asarray(prob, amps.dtype)
-    s1 = kernels.parity_sign(nn, (qubit1, qubit1 + n), amps.dtype)
-    s2 = kernels.parity_sign(nn, (qubit2, qubit2 + n), amps.dtype)
+    s1 = kernels.parity_sign_2d(nn, (qubit1, qubit1 + n), amps.dtype)
+    s2 = kernels.parity_sign_2d(nn, (qubit2, qubit2 + n), amps.dtype)
+    view = amps.reshape(2, s1.shape[0], s1.shape[1])
     factor = (1 - prob) + (prob / 3) * (s1 + s2 + s1 * s2)
     return (view * factor[None]).reshape(2, -1)
 

@@ -24,51 +24,46 @@ from . import cplx
 PAULI_I, PAULI_X, PAULI_Y, PAULI_Z = 0, 1, 2, 3
 
 
-def apply_pauli_string(view, n: int, targets: Tuple[int, ...], codes: Tuple[int, ...]):
-    """Apply a Pauli product to a (2,) + (2,)*n SoA view using only flips
-    (X), broadcast sign masks (Z), and their composition
-    (Y: amp'_b = (+/-i) amp_{1-b}).
+def apply_pauli_string(amps, n: int, targets: Tuple[int, ...], codes: Tuple[int, ...]):
+    """Apply a Pauli product to flat (2, 2^n) SoA amps using only axis flips
+    (X), a parity sign mask (Z), and their composition (Y).
 
+    Factorization: flipping all X and Y targets, the residual elementwise
+    factor is (-i)^{#Y} * (-1)^{parity(Z and Y bits)} — Y|b> = i(2b'-1)|b'>
+    with b' the flipped bit, and i(2b'-1) = -i * (-1)^{b'}.  So one multi-
+    flip plus one fused parity multiply, never a high-rank broadcast.
     Matches statevec_applyPauliProd (QuEST_common.c:505-516) semantics.
     """
-    flip_axes = []
-    factors = []  # (qubit-axis-sans-channel, re-vec or None, im-vec or None)
+    from .kernels import _flip_bits_flat, parity_sign_2d
+
+    flips = []
+    par = []
+    num_y = 0
     for t, c in zip(targets, codes):
-        ax = n - 1 - t  # axis in the channel-less (2,)*n layout
-        if c == PAULI_I:
-            continue
-        elif c == PAULI_X:
-            flip_axes.append(1 + ax)
+        if c == PAULI_X:
+            flips.append(t)
         elif c == PAULI_Z:
-            factors.append((ax, jnp.array([1.0, -1.0]), None))
+            par.append(t)
         elif c == PAULI_Y:
-            # Y|0> = i|1>, Y|1> = -i|0>: flip, then multiply by i*[-1, +1]
-            # indexed by the NEW bit value.
-            flip_axes.append(1 + ax)
-            factors.append((ax, None, jnp.array([-1.0, 1.0])))
-    if flip_axes:
-        view = jnp.flip(view, axis=tuple(flip_axes))
-    if factors:
-        f_re = jnp.ones((1,) * n, dtype=view.dtype)
-        f_im = jnp.zeros((1,) * n, dtype=view.dtype)
-        for ax, re_vec, im_vec in factors:
-            shape = [1] * n
-            shape[ax] = 2
-            if re_vec is not None:
-                v = re_vec.astype(view.dtype).reshape(shape)
-                f_re = f_re * v
-                f_im = f_im * v
-            else:
-                v = im_vec.astype(view.dtype).reshape(shape)
-                f_re, f_im = -f_im * v, f_re * v
-        view = cplx.cmul(view, f_re, f_im)
-    return view
+            flips.append(t)
+            par.append(t)
+            num_y += 1
+    amps = _flip_bits_flat(amps, n, tuple(flips))
+    if not par and num_y % 4 == 0:
+        return amps
+    # constant (-i)^{#Y}: one of 1, -i, -1, i
+    c_re, c_im = [(1.0, 0.0), (0.0, -1.0), (-1.0, 0.0), (0.0, 1.0)][num_y % 4]
+    if par:
+        s = parity_sign_2d(n, par, amps.dtype)
+        view = amps.reshape(2, s.shape[0], s.shape[1])
+        return cplx.cmul(view, c_re * s, c_im * s).reshape(2, -1)
+    return cplx.cmul(amps, jnp.asarray(c_re, amps.dtype),
+                     jnp.asarray(c_im, amps.dtype))
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "targets", "codes"), donate_argnums=0)
 def apply_pauli_prod(amps, *, num_qubits: int, targets: Tuple[int, ...], codes: Tuple[int, ...]):
-    view = amps.reshape((2,) + (2,) * num_qubits)
-    return apply_pauli_string(view, num_qubits, targets, codes).reshape(2, -1)
+    return apply_pauli_string(amps, num_qubits, targets, codes)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms"))
@@ -77,14 +72,13 @@ def calc_expec_pauli_sum_statevec(amps, coeffs, *, num_qubits: int,
     """Re <psi| sum_t c_t P_t |psi> as ONE fused program (reference loops
     clone+apply+innerProduct per term, QuEST_common.c:534-546)."""
     n = num_qubits
-    view = amps.reshape((2,) + (2,) * n)
     coeffs = jnp.asarray(coeffs, amps.dtype)
     total = jnp.zeros((), amps.dtype)
     for t in range(num_terms):
         codes = codes_flat[t * n:(t + 1) * n]
-        pv = apply_pauli_string(view, n, tuple(range(n)), codes)
-        # Re <view|pv>
-        total = total + coeffs[t] * jnp.sum(view[0] * pv[0] + view[1] * pv[1])
+        pv = apply_pauli_string(amps, n, tuple(range(n)), codes)
+        # Re <amps|pv>
+        total = total + coeffs[t] * jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
     return total
 
 
@@ -97,12 +91,11 @@ def calc_expec_pauli_sum_density(amps, coeffs, *, num_qubits: int,
     n = num_qubits
     nn = 2 * n
     dim = 1 << n
-    view = amps.reshape((2,) + (2,) * nn)
     coeffs = jnp.asarray(coeffs, amps.dtype)
     total = jnp.zeros((), amps.dtype)
     for t in range(num_terms):
         codes = codes_flat[t * n:(t + 1) * n]
-        pv = apply_pauli_string(view, nn, tuple(range(n)), codes)
+        pv = apply_pauli_string(amps, nn, tuple(range(n)), codes)
         tr_re = jnp.sum(jnp.diagonal(pv[0].reshape(dim, dim)))
         total = total + coeffs[t] * tr_re
     return total
@@ -118,12 +111,11 @@ def apply_pauli_sum(amps, coeffs, out_amps, *, num_qubits: int,
     codes act on the ket (low) qubits only."""
     n = num_qubits
     nsv = num_state_qubits
-    view = amps.reshape((2,) + (2,) * nsv)
     coeffs = jnp.asarray(coeffs, amps.dtype)
-    acc = jnp.zeros_like(view)
+    acc = jnp.zeros_like(amps)
     for t in range(num_terms):
         codes = codes_flat[t * n:(t + 1) * n]
-        pv = apply_pauli_string(view, nsv, tuple(range(n)), codes)
+        pv = apply_pauli_string(amps, nsv, tuple(range(n)), codes)
         acc = acc + coeffs[t] * pv
     del out_amps  # donated buffer re-used by XLA for the result
-    return acc.reshape(2, -1)
+    return acc

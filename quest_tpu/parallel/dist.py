@@ -47,7 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..env import AMP_AXIS
-from ..ops import cplx
+from ..ops import cplx, kernels
 
 _CONFIG = {"explicit": True}
 
@@ -137,13 +137,9 @@ def apply_matrix_1q_sharded(
             return cplx.cmul(own_block, a_re, a_im) + cplx.cmul(recv_block, b_re, b_im)
 
         if local_controls:
-            nl = nloc
-            sel = [slice(None)] * (nl + 1)
-            for c, s in local_controls:
-                sel[1 + (nl - 1 - c)] = int(s)
-            sel = tuple(sel)
-            lv = local.reshape((2,) + (2,) * nl)
-            rv = recv.reshape((2,) + (2,) * nl)
+            shape, sel = kernels._interleaved_sel(nloc, local_controls)
+            lv = local.reshape(shape)
+            rv = recv.reshape(shape)
             new = lv.at[sel].set(combine(lv[sel], rv[sel]))
             new = new.reshape(2, -1)
         else:
@@ -179,18 +175,15 @@ def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int, qb_high: int
     assert qb_high >= nloc and qb_low < nloc
     bit = qb_high - nloc
     perm = _hypercube_perm(ndev, bit)
-    ax = 1 + (nloc - 1 - qb_low)
 
     def kernel(local):
         idx = lax.axis_index(AMP_AXIS)
         u = (idx >> bit) & 1
-        lv = local.reshape((2,) + (2,) * nloc)
+        lv = local.reshape(2, 1 << (nloc - 1 - qb_low), 2, 1 << qb_low)
         # dynamic half-selection: take(lv, 1-u) along the low-qubit axis
-        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=ax, keepdims=False)
+        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
         recv = lax.ppermute(send, AMP_AXIS, perm)
-        new = lax.dynamic_update_index_in_dim(
-            lv, recv, 1 - u, axis=ax
-        )
+        new = lax.dynamic_update_index_in_dim(lv, recv, 1 - u, axis=2)
         return new.reshape(2, -1)
 
     return shard_map(

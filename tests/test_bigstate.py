@@ -1,0 +1,213 @@
+"""Float32 oracle sweep of the layout-safe big-state (n >= 14) kernel paths.
+
+The main oracle suite runs at NUM_QUBITS=5 in float64, which exercises only
+the small-n einsum paths of ops/kernels.py.  The n >= _BIG_N rewrite (slab
+decomposition, lane matmuls, iota indicators, contiguous control slicing —
+see the layout-safety note in ops/kernels.py) is covered here at n=14 in
+float32, the production dtype, against a dense NumPy oracle.  This is the
+test tier that catches stray default-precision (bf16-on-TPU) contractions
+and big-path-only logic bugs (cf. reference test strategy SURVEY.md §4).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quest_tpu.ops import calculations, cplx, kernels
+
+N = 14
+M = 1 << N
+ATOL = 5e-6  # float32 single-pass kernels
+
+
+def _rand_state(rng):
+    psi = rng.normal(size=(2, M)).astype(np.float32)
+    psi /= np.sqrt((psi ** 2).sum())
+    return psi
+
+
+def _rand_unitary(k, rng):
+    d = 1 << k
+    a = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+    q, _ = np.linalg.qr(a)
+    return q
+
+
+def _dense_apply(psi, u, targets, n, controls=(), control_states=()):
+    """Dense oracle: apply u (2^k x 2^k, bit j of the matrix index =
+    targets[j]) on the full state, optionally controlled."""
+    z = psi[0] + 1j * psi[1]
+    idx = np.arange(1 << n)
+    sel = np.ones(1 << n, dtype=bool)
+    states = control_states or (1,) * len(controls)
+    for c, s in zip(controls, states):
+        sel &= ((idx >> c) & 1) == s
+    k = len(targets)
+    sub = np.zeros(1 << n, dtype=np.int64)
+    for j, t in enumerate(targets):
+        sub |= ((idx >> t) & 1) << j
+    rest = idx.copy()
+    for t in targets:
+        rest &= ~(1 << t)
+    out = z.copy()
+    # group amplitudes by rest pattern, matvec the 2^k block
+    order = np.lexsort((sub, rest))
+    zi = z[order].reshape(-1, 1 << k)
+    zi = zi @ u.T
+    upd = np.empty_like(z)
+    upd[order] = zi.reshape(-1)
+    out[sel] = upd[sel]
+    return np.stack([out.real, out.imag]).astype(np.float32)
+
+
+TARGET_SETS = [
+    (0,), (6,), (7,), (13,),
+    (0, 1), (6, 7), (12, 13), (3, 10), (13, 2),
+    (0, 7, 13), (5, 6, 7), (2, 9, 12),
+]
+
+
+@pytest.mark.parametrize("targets", TARGET_SETS)
+def test_apply_matrix_oracle(targets):
+    rng = np.random.default_rng(hash(targets) % 2 ** 31)
+    psi = _rand_state(rng)
+    u = _rand_unitary(len(targets), rng)
+    got = np.asarray(kernels.apply_matrix(
+        jnp.asarray(psi), cplx.soa(u, np.float32), num_qubits=N,
+        targets=targets,
+    ))
+    want = _dense_apply(psi, u, targets, N)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+CONTROL_CASES = [
+    # controls straddling the lane boundary in every combination
+    ((3,), (1,), (9,)),
+    ((9,), (0,), (3,)),
+    ((2, 11), (1, 1), (6,)),
+    ((6, 7), (1, 0), (13,)),
+    ((12, 1), (0, 1), (7, 0)),
+]
+
+
+@pytest.mark.parametrize("controls,states,targets", CONTROL_CASES)
+def test_controlled_matrix_oracle(controls, states, targets):
+    rng = np.random.default_rng(11)
+    psi = _rand_state(rng)
+    u = _rand_unitary(len(targets), rng)
+    got = np.asarray(kernels.apply_matrix(
+        jnp.asarray(psi), cplx.soa(u, np.float32), num_qubits=N,
+        targets=targets, controls=controls, control_states=states,
+    ))
+    want = _dense_apply(psi, u, targets, N, controls, states)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+@pytest.mark.parametrize("targets", [(0,), (9,), (2, 11), (0, 5, 9), (7, 8)])
+def test_apply_diagonal_oracle(targets):
+    rng = np.random.default_rng(5)
+    psi = _rand_state(rng)
+    k = len(targets)
+    d = np.exp(1j * rng.normal(size=(1 << k,)))
+    got = np.asarray(kernels.apply_diagonal(
+        jnp.asarray(psi), cplx.soa(d, np.float32), num_qubits=N,
+        targets=targets,
+    ))
+    want = _dense_apply(psi, np.diag(d), targets, N)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+@pytest.mark.parametrize("controls,states,targets", [
+    ((2,), (1,), (9, 13)), ((10,), (1,), (0, 4)),
+])
+def test_controlled_diagonal_oracle(controls, states, targets):
+    rng = np.random.default_rng(6)
+    psi = _rand_state(rng)
+    k = len(targets)
+    d = np.exp(1j * rng.normal(size=(1 << k,)))
+    got = np.asarray(kernels.apply_diagonal(
+        jnp.asarray(psi), cplx.soa(d, np.float32), num_qubits=N,
+        targets=targets, controls=controls, control_states=states,
+    ))
+    want = _dense_apply(psi, np.diag(d), targets, N, controls, states)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+@pytest.mark.parametrize("targets", [(0,), (3, 8), (1, 2, 13), (7, 9), (0, 6)])
+def test_multi_qubit_not_oracle(targets):
+    rng = np.random.default_rng(7)
+    psi = _rand_state(rng)
+    got = np.asarray(kernels.apply_multi_qubit_not(
+        jnp.asarray(psi), num_qubits=N, targets=targets,
+    ))
+    x = np.array([[0, 1], [1, 0]], dtype=complex)
+    u = np.eye(1, dtype=complex)
+    for _ in targets:
+        u = np.kron(x, u)
+    want = _dense_apply(psi, u, targets, N)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+@pytest.mark.parametrize("qubits", [(4,), (0, 9), (2, 7, 13)])
+def test_parity_phase_oracle(qubits):
+    rng = np.random.default_rng(8)
+    psi = _rand_state(rng)
+    theta = 0.731
+    got = np.asarray(kernels.apply_parity_phase(
+        jnp.asarray(psi), np.float32(theta), num_qubits=N, qubits=qubits,
+    ))
+    idx = np.arange(M)
+    par = np.zeros(M, dtype=np.int64)
+    for q in qubits:
+        par ^= (idx >> q) & 1
+    z = (psi[0] + 1j * psi[1]) * np.exp(-0.5j * theta * (1 - 2 * par))
+    want = np.stack([z.real, z.imag]).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=ATOL)
+
+
+def test_swap_and_segment_swap_oracle():
+    rng = np.random.default_rng(9)
+    psi = _rand_state(rng)
+    idx = np.arange(M)
+    # single-bit swap through the dense path
+    got = np.asarray(kernels.swap_qubit_amps(
+        jnp.asarray(psi), num_qubits=N, qb1=2, qb2=12))
+    b2, b12 = (idx >> 2) & 1, (idx >> 12) & 1
+    src = (idx & ~((1 << 2) | (1 << 12))) | (b12 << 2) | (b2 << 12)
+    np.testing.assert_allclose(got, psi[:, src], atol=0)
+    # segment swap [10,13) <-> [7,10)
+    got = np.asarray(kernels.swap_bit_segments(
+        jnp.asarray(psi), num_qubits=N, a=10, b=7, m=3))
+    segA = (idx >> 10) & 0b111
+    segB = (idx >> 7) & 0b111
+    src = (idx & ~(0b111111 << 7)) | (segB << 10) | (segA << 7)
+    np.testing.assert_allclose(got, psi[:, src], atol=0)
+
+
+def test_prob_and_histogram_oracle():
+    rng = np.random.default_rng(10)
+    psi = _rand_state(rng)
+    probs = np.abs(psi[0] + 1j * psi[1]) ** 2
+    idx = np.arange(M)
+    p = calculations.calc_prob_of_outcome_statevec(
+        jnp.asarray(psi), num_qubits=N, target=5, outcome=1)
+    assert abs(float(p) - probs[((idx >> 5) & 1) == 1].sum()) < 1e-6
+    qubits = (3, 11, 0)
+    h = np.asarray(calculations.calc_prob_of_all_outcomes_statevec(
+        jnp.asarray(psi), num_qubits=N, qubits=qubits))
+    code = sum(((idx >> q) & 1) << j for j, q in enumerate(qubits))
+    want = np.bincount(code, weights=probs, minlength=8)
+    np.testing.assert_allclose(h, want, atol=1e-6)
+
+
+def test_collapse_oracle():
+    rng = np.random.default_rng(12)
+    psi = _rand_state(rng)
+    idx = np.arange(M)
+    probs = np.abs(psi[0] + 1j * psi[1]) ** 2
+    p1 = probs[((idx >> 9) & 1) == 1].sum()
+    got = np.asarray(kernels.collapse_statevec(
+        jnp.asarray(psi), np.float32(p1), num_qubits=N, target=9, outcome=1))
+    z = (psi[0] + 1j * psi[1]) * (((idx >> 9) & 1) == 1) / np.sqrt(p1)
+    want = np.stack([z.real, z.imag]).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=ATOL)

@@ -199,8 +199,8 @@ class TestNativeScheduler:
         ops_nat = C.plan_circuit(gates, n, use_native=True)
         assert [o[0] for o in ops_py] == [o[0] for o in ops_nat]
         for a, b in zip(ops_py, ops_nat):
-            if a[0] == "permute":
-                assert a[1] == b[1]
+            if a[0] in ("permute", "segswap"):
+                assert tuple(a[1:]) == tuple(b[1:])
             elif a[0] == "apply":
                 assert tuple(a[1]) == tuple(b[1])
                 np.testing.assert_allclose(np.asarray(a[2]), np.asarray(b[2]))

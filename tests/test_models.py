@@ -55,8 +55,13 @@ class TestQAOA:
         n = 5
         edges = qaoa_mod.random_graph(n, 6, seed=3)
         model = qaoa_mod.QAOA(n, edges, depth=1)
-        got = np.asarray(model._cost_view(jnp.float64)).reshape(-1)
-        # view axis order: axis k is qubit n-1-k, so flat view index IS the
+        from quest_tpu.ops.kernels import _split2
+
+        hi, lo = _split2(n)
+        got = np.broadcast_to(
+            np.asarray(model._cost_2d(jnp.float64)), (1 << hi, 1 << lo)
+        ).reshape(-1)
+        # (2^hi, 2^lo) row-major: flat index = ihi * 2^lo + ilo IS the
         # amplitude index
         np.testing.assert_allclose(got, self._dense_cut(n, edges), atol=1e-12)
 
