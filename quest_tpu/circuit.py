@@ -138,9 +138,13 @@ class _Plan:
         self.accA = None  # traced (2,128,128) or None
         self.accB = None
         self.count = 0  # gates folded since last flush
-        # segment length for relocation swaps (page size)
-        self.seg = min(LANE, max(0, num_qubits - WINDOW))
-        self.swap_stack: List[Tuple[int, int]] = []  # (h, b) per segswap
+        # relocation segment (page) size bounds: m <= seg_max by available
+        # high bits; m >= seg_min = 3 keeps the 2^m segment axis a multiple
+        # of the 8-sublane tile (no transpose padding) except when fewer
+        # high bits exist at all
+        self.seg_max = min(LANE, max(0, num_qubits - WINDOW))
+        self.seg_min = min(3, self.seg_max) if self.seg_max > 0 else 0
+        self.swap_stack: List[Tuple[int, int, int]] = []  # (h, b, m)
 
     def _fold(self, cluster: str, bits: Tuple[int, ...], mat):
         e = embed_in_cluster(mat, bits)
@@ -162,9 +166,8 @@ class _Plan:
         self.accA = self.accB = None
         self.count = 0
 
-    def _emit_segswap(self, h: int, b: int):
-        """Exchange bit segments [h, h+seg) <-> [b, b+seg)."""
-        m = self.seg
+    def _emit_segswap(self, h: int, b: int, m: int):
+        """Exchange bit segments [h, h+m) <-> [b, b+m)."""
         self.flush()
         self.ops.append(("segswap", h, b, m))
         newpos = []
@@ -177,57 +180,10 @@ class _Plan:
                 newpos.append(p)
         self.pos = newpos
 
-    def page_in(self, phys: Sequence[int], future_targets) -> bool:
-        """Try one segment swap making ``phys`` window-coverable: pull the
-        page containing all high positions into the sublane window — the
-        TPU-native analogue of the reference's per-qubit SWAP-relocalization
-        (QuEST_cpu_distributed.c:1503-1545), but page-granular so the move
-        is a tile-aligned transpose (kernels.swap_bit_segments).
-
-        The evicted window page [b, b+seg) is chosen by lookahead: the
-        candidate whose current occupants are needed furthest in the future
-        (``future_targets`` = iterator of upcoming logical targets)."""
-        m = self.seg
-        if m <= 0:
-            return False
-        high = [p for p in phys if p >= WINDOW]
-        if not high:
-            return False
-        lo_h = max(WINDOW, max(high) - m + 1)
-        hi_h = min(self.n - m, min(high))
-        if lo_h > hi_h:
-            return False
-        h = hi_h
-        # candidate eviction pages: must not contain this gate's own
-        # window-resident targets
-        lowpos = set(p for p in phys if p < WINDOW)
-        cands = [b for b in range(LANE, WINDOW - m + 1)
-                 if not any(b <= p < b + m for p in lowpos)]
-        if not cands:
-            return False
-        if len(cands) > 1:
-            # next-use distance of each position (capped horizon)
-            next_use = {}
-            for d, t in enumerate(future_targets):
-                p = self.pos[t]
-                if p not in next_use:
-                    next_use[p] = d
-                if d >= _LOOKAHEAD:
-                    break
-            def score(b):
-                return min((next_use.get(p, _LOOKAHEAD + 1)
-                            for p in range(b, b + m)), default=0)
-            b = max(cands, key=lambda c: (score(c), -c))
-        else:
-            b = cands[0]
-        self._emit_segswap(h, b)
-        self.swap_stack.append((h, b))
-        return True
-
     def final_restore(self):
         self.flush()
-        for h, b in reversed(self.swap_stack):
-            self._emit_segswap(h, b)
+        for h, b, m in reversed(self.swap_stack):
+            self._emit_segswap(h, b, m)
         self.swap_stack = []
         assert self.pos == list(range(self.n))
 
@@ -279,37 +235,152 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
 
 
 def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
-    """Greedy one-pass scheduler: fold into clusters, page-swap high bits
-    into the sublane window, standard-kernel fallback for the rest."""
+    """Dependency-DAG list scheduler.
+
+    Gates sharing no qubit commute, so the per-qubit program-order queues
+    define the only real ordering constraints.  The scheduler repeatedly
+    (1) folds every *ready* gate that sits inside a cluster, (2) when
+    nothing folds, picks the segment swap that makes the most ready gates
+    foldable (>= 2, else not worth the extra pass), (3) otherwise pops the
+    smallest ready gate through the standard layout-safe kernel.  This
+    batches a whole circuit layer per cluster pass instead of flushing at
+    the first non-resident gate (the reference has no such scheduler at
+    all — it dispatches gate-at-a-time, QuEST/src/QuEST.c)."""
     n = num_qubits
+    glist = list(gates)
     if n < WINDOW:
         # Too small for the cluster kernel: program = plain per-gate applies.
-        return [("apply", g.targets, g.mat) for g in gates]
+        return [("apply", g.targets, g.mat) for g in glist]
 
     plan = _Plan(n)
-    glist = list(gates)
-
-    def future(gi):
-        for gg in itertools.islice(glist, gi, None):
-            yield from gg.targets
-
+    num_gates = len(glist)
+    queues: List[List[int]] = [[] for _ in range(n)]
     for gi, g in enumerate(glist):
-        phys = tuple(plan.pos[t] for t in g.targets)
+        for t in g.targets:
+            queues[t].append(gi)
+    heads = [0] * n
+
+    def is_ready(gi):
+        return all(
+            heads[t] < len(queues[t]) and queues[t][heads[t]] == gi
+            for t in glist[gi].targets
+        )
+
+    ready = sorted(gi for gi in range(num_gates) if is_ready(gi))
+    done = 0
+
+    def pop(gi):
+        nonlocal done, ready
+        for t in glist[gi].targets:
+            heads[t] += 1
+        done += 1
+        ready.remove(gi)
+        # gates newly at all their heads
+        for t in glist[gi].targets:
+            if heads[t] < len(queues[t]):
+                cand = queues[t][heads[t]]
+                if cand not in ready and is_ready(cand):
+                    ready.append(cand)
+        ready.sort()
+
+    def phys_of(gi):
+        return tuple(plan.pos[t] for t in glist[gi].targets)
+
+    def try_fold(gi):
+        phys = phys_of(gi)
         cl = _cluster_of(phys)
-        if cl is not None:
-            bits = tuple(p if cl == "A" else p - LANE for p in phys)
-            plan._fold(cl, bits, g.mat)
-            continue
-        if any(p >= WINDOW for p in phys) and plan.page_in(phys, future(gi)):
-            phys = tuple(plan.pos[t] for t in g.targets)
-            cl = _cluster_of(phys)
-            if cl is not None:
-                bits = tuple(p if cl == "A" else p - LANE for p in phys)
-                plan._fold(cl, bits, g.mat)
+        if cl is None:
+            return False
+        bits = tuple(p if cl == "A" else p - LANE for p in phys)
+        plan._fold(cl, bits, glist[gi].mat)
+        pop(gi)
+        return True
+
+    def swapped_pos(p, h, b, m):
+        if b <= p < b + m:
+            return h + (p - b)
+        if h <= p < h + m:
+            return b + (p - h)
+        return p
+
+    def best_swap():
+        """(h, b, m) of the segment swap enabling the most ready folds;
+        None if no swap enables >= 2.  Variable width m lets a swap pull a
+        high page in while KEEPING a window-resident partner qubit — e.g. a
+        gate on (sublane 8, grid 21) folds after a 3-bit swap that evicts
+        [9, 12) only."""
+        if plan.seg_max <= 0:
+            return None
+        cand_hm = []
+        for gi in ready:
+            high = [p for p in phys_of(gi) if p >= WINDOW]
+            if not high:
                 continue
-        # cross-cluster or un-pageable: standard layout-safe kernel
+            span = max(high) - min(high) + 1
+            for m in range(max(plan.seg_min, span), plan.seg_max + 1):
+                lo_h = max(WINDOW, max(high) - m + 1)
+                hi_h = min(n - m, min(high))
+                if lo_h <= hi_h and (hi_h, m) not in cand_hm:
+                    cand_hm.append((hi_h, m))
+        if not cand_hm:
+            return None
+        cand_hm.sort()
+        # next-use distance per physical position (capped horizon), over
+        # pending gate-target occurrences in gate-index order (queues are
+        # sorted, so gi is pending on qubit t iff gi >= queues[t][heads[t]])
+        next_use = {}
+        d = 0
+        for gi in range(num_gates):
+            if d > _LOOKAHEAD:
+                break
+            for t in glist[gi].targets:
+                if d > _LOOKAHEAD:
+                    break
+                q = queues[t]
+                hpos = heads[t]
+                if hpos < len(q) and gi >= q[hpos]:
+                    p = plan.pos[t]
+                    if p not in next_use:
+                        next_use[p] = d
+                    d += 1
+        best = None
+        for h, m in cand_hm:
+            for b in range(LANE, WINDOW - m + 1):
+                count = 0
+                for gi in ready:
+                    pp = tuple(swapped_pos(p, h, b, m) for p in phys_of(gi))
+                    if _cluster_of(pp) is not None:
+                        count += 1
+                evict = min(
+                    (next_use.get(p, _LOOKAHEAD + 1) for p in range(b, b + m)),
+                    default=0,
+                )
+                key = (count, evict, -m, -h, -b)
+                if best is None or key > best[0]:
+                    best = (key, h, b, m)
+        if best is None or best[0][0] < 2:
+            return None
+        return best[1], best[2], best[3]
+
+    while done < num_gates:
+        progressed = True
+        while progressed:
+            progressed = False
+            for gi in list(ready):
+                if try_fold(gi):
+                    progressed = True
+        if done == num_gates:
+            break
+        sw = best_swap()
+        if sw is not None:
+            h, b, m = sw
+            plan._emit_segswap(h, b, m)
+            plan.swap_stack.append((h, b, m))
+            continue
+        gi = ready[0]
         plan.flush()
-        plan.ops.append(("apply", phys, g.mat))
+        plan.ops.append(("apply", phys_of(gi), glist[gi].mat))
+        pop(gi)
     plan.final_restore()
     return plan.ops
 

@@ -52,14 +52,16 @@ struct Plan {
   std::vector<int64_t> pos;  // pos[logical] = physical
   std::vector<Fold> accA, accB;
   int64_t n;
-  int64_t seg;                       // relocation page size
-  std::vector<std::pair<int64_t, int64_t>> swap_stack;  // (h, b) per segswap
+  int64_t seg_max, seg_min;  // relocation page size bounds (see circuit.py)
+  struct Swap { int64_t h, b, m; };
+  std::vector<Swap> swap_stack;
 
   explicit Plan(int64_t n_) : pos(n_), n(n_) {
     for (int64_t q = 0; q < n; ++q) pos[q] = q;
-    seg = n - kWindow;
-    if (seg > kLane) seg = kLane;
-    if (seg < 0) seg = 0;
+    seg_max = n - kWindow;
+    if (seg_max > kLane) seg_max = kLane;
+    if (seg_max < 0) seg_max = 0;
+    seg_min = seg_max > 0 ? std::min<int64_t>(3, seg_max) : 0;
   }
 
   void flush() {
@@ -78,17 +80,17 @@ struct Plan {
     ++num_ops;
   }
 
-  void emit_segswap(int64_t h, int64_t b) {
+  void emit_segswap(int64_t h, int64_t b, int64_t m) {
     flush();
     buf.push_back(3);
     buf.push_back(h);
     buf.push_back(b);
-    buf.push_back(seg);
+    buf.push_back(m);
     ++num_ops;
     for (auto& p : pos) {
-      if (p >= b && p < b + seg)
+      if (p >= b && p < b + m)
         p = h + (p - b);
-      else if (p >= h && p < h + seg)
+      else if (p >= h && p < h + m)
         p = b + (p - h);
     }
   }
@@ -96,7 +98,7 @@ struct Plan {
   void final_restore() {
     flush();
     for (auto it = swap_stack.rbegin(); it != swap_stack.rend(); ++it)
-      emit_segswap(it->first, it->second);
+      emit_segswap(it->h, it->b, it->m);
     swap_stack.clear();
   }
 
@@ -146,55 +148,21 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
     return phys;
   };
 
-  // Mirrors _Plan.page_in in circuit.py (identical plans asserted by
-  // tests/test_circuit.py): one segment swap pulling the page containing
-  // all high positions of phys into the sublane window, evicting the page
-  // whose occupants are needed furthest in the future.
-  auto page_in = [&](int64_t g, const std::vector<int64_t>& phys) -> bool {
-    const int64_t m = plan.seg;
-    if (m <= 0) return false;
-    int64_t hmin = -1, hmax = -1;
-    for (int64_t p : phys)
-      if (p >= kWindow) {
-        if (hmin < 0 || p < hmin) hmin = p;
-        if (p > hmax) hmax = p;
-      }
-    if (hmin < 0) return false;
-    int64_t lo_h = std::max<int64_t>(kWindow, hmax - m + 1);
-    int64_t hi_h = std::min<int64_t>(n - m, hmin);
-    if (lo_h > hi_h) return false;
-    const int64_t h = hi_h;
-    std::vector<int64_t> cands;
-    for (int64_t b = kLane; b <= kWindow - m; ++b) {
-      bool ok = true;
-      for (int64_t p : phys)
-        if (p < kWindow && p >= b && p < b + m) ok = false;
-      if (ok) cands.push_back(b);
+  // Dependency-DAG list scheduler state; mirrors plan_circuit_py in
+  // circuit.py line by line (identical plans asserted by
+  // tests/test_circuit.py).
+  std::vector<std::vector<int64_t>> queues(n);
+  for (int64_t g = 0; g < num_gates; ++g)
+    for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i)
+      queues[targets[i]].push_back(g);
+  std::vector<int64_t> heads(n, 0);
+
+  auto is_ready = [&](int64_t g) {
+    for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+      int64_t t = targets[i];
+      if (heads[t] >= (int64_t)queues[t].size() || queues[t][heads[t]] != g)
+        return false;
     }
-    if (cands.empty()) return false;
-    int64_t best = cands[0];
-    if (cands.size() > 1) {
-      std::vector<int64_t> next_use(n, kLookahead + 1);
-      int64_t d = 0;
-      for (int64_t gg = g; gg < num_gates && d <= kLookahead; ++gg)
-        for (int64_t i = offsets[gg]; i < offsets[gg + 1] && d <= kLookahead;
-             ++i, ++d) {
-          int64_t p = plan.pos[targets[i]];
-          if (next_use[p] > d) next_use[p] = d;
-        }
-      int64_t best_score = -1;
-      for (int64_t b : cands) {
-        int64_t score = kLookahead + 1;
-        for (int64_t p = b; p < b + m; ++p)
-          score = std::min(score, next_use[p]);
-        if (score > best_score) {
-          best_score = score;
-          best = b;
-        }
-      }
-    }
-    plan.emit_segswap(h, best);
-    plan.swap_stack.emplace_back(h, best);
     return true;
   };
 
@@ -202,26 +170,140 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
     // too small for the cluster kernel: plain per-gate applies
     for (int64_t g = 0; g < num_gates; ++g) plan.emit_apply(g, phys_of(g));
   } else {
-    for (int64_t g = 0; g < num_gates; ++g) {
-      std::vector<int64_t> phys = phys_of(g);
-      int cl = cluster_of(phys);
-      if (cl >= 0) {
-        fold(plan, cl, g, phys);
-        continue;
-      }
-      bool has_high = false;
-      for (int64_t p : phys) has_high = has_high || p >= kWindow;
-      if (has_high && page_in(g, phys)) {
-        phys = phys_of(g);
-        cl = cluster_of(phys);
-        if (cl >= 0) {
-          fold(plan, cl, g, phys);
-          continue;
+    std::vector<int64_t> ready;
+    for (int64_t g = 0; g < num_gates; ++g)
+      if (is_ready(g)) ready.push_back(g);
+    int64_t done = 0;
+
+    auto pop = [&](int64_t g) {
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) ++heads[targets[i]];
+      ++done;
+      ready.erase(std::find(ready.begin(), ready.end(), g));
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+        int64_t t = targets[i];
+        if (heads[t] < (int64_t)queues[t].size()) {
+          int64_t cand = queues[t][heads[t]];
+          if (std::find(ready.begin(), ready.end(), cand) == ready.end() &&
+              is_ready(cand))
+            ready.push_back(cand);
         }
       }
-      // cross-cluster or un-pageable: standard layout-safe kernel
+      std::sort(ready.begin(), ready.end());
+    };
+
+    auto try_fold = [&](int64_t g) {
+      std::vector<int64_t> phys = phys_of(g);
+      int cl = cluster_of(phys);
+      if (cl < 0) return false;
+      fold(plan, cl, g, phys);
+      pop(g);
+      return true;
+    };
+
+    auto swapped_pos = [&](int64_t p, int64_t h, int64_t b, int64_t m) {
+      if (p >= b && p < b + m) return h + (p - b);
+      if (p >= h && p < h + m) return b + (p - h);
+      return p;
+    };
+
+    // (found, h, b, m) of the segment swap enabling the most ready folds
+    auto best_swap = [&](int64_t& out_h, int64_t& out_b,
+                         int64_t& out_m) -> bool {
+      if (plan.seg_max <= 0) return false;
+      std::vector<std::pair<int64_t, int64_t>> cand_hm;
+      for (int64_t g : ready) {
+        int64_t hmin = -1, hmax = -1;
+        for (int64_t p : phys_of(g))
+          if (p >= kWindow) {
+            if (hmin < 0 || p < hmin) hmin = p;
+            if (p > hmax) hmax = p;
+          }
+        if (hmin < 0) continue;
+        int64_t span = hmax - hmin + 1;
+        for (int64_t m = std::max(plan.seg_min, span); m <= plan.seg_max;
+             ++m) {
+          int64_t lo_h = std::max<int64_t>(kWindow, hmax - m + 1);
+          int64_t hi_h = std::min<int64_t>(n - m, hmin);
+          if (lo_h <= hi_h &&
+              std::find(cand_hm.begin(), cand_hm.end(),
+                        std::make_pair(hi_h, m)) == cand_hm.end())
+            cand_hm.emplace_back(hi_h, m);
+        }
+      }
+      if (cand_hm.empty()) return false;
+      std::sort(cand_hm.begin(), cand_hm.end());
+      // next-use distance per physical position over pending gate-target
+      // occurrences in gate-index order
+      std::vector<int64_t> next_use(n, kLookahead + 1);
+      int64_t d = 0;
+      for (int64_t g = 0; g < num_gates && d <= kLookahead; ++g)
+        for (int64_t i = offsets[g]; i < offsets[g + 1] && d <= kLookahead;
+             ++i) {
+          int64_t t = targets[i];
+          if (heads[t] < (int64_t)queues[t].size() &&
+              g >= queues[t][heads[t]]) {
+            int64_t p = plan.pos[t];
+            if (next_use[p] > kLookahead) next_use[p] = d;
+            ++d;
+          }
+        }
+      bool have = false;
+      int64_t bc = -1, be = -1, bm = -1, bh = -1, bb = -1;
+      for (auto [h, m] : cand_hm) {
+        for (int64_t b = kLane; b <= kWindow - m; ++b) {
+          int64_t count = 0;
+          for (int64_t g : ready) {
+            std::vector<int64_t> pp = phys_of(g);
+            for (auto& p : pp) p = swapped_pos(p, h, b, m);
+            if (cluster_of(pp) >= 0) ++count;
+          }
+          int64_t evict = kLookahead + 1;
+          for (int64_t p = b; p < b + m; ++p)
+            evict = std::min(evict, next_use[p]);
+          // lexicographic key (count, evict, -m, -h, -b), maximized
+          bool better = false;
+          if (!have) better = true;
+          else if (count != bc) better = count > bc;
+          else if (evict != be) better = evict > be;
+          else if (m != bm) better = m < bm;
+          else if (h != bh) better = h < bh;
+          else if (b != bb) better = b < bb;
+          if (better) {
+            have = true;
+            bc = count;
+            be = evict;
+            bm = m;
+            bh = h;
+            bb = b;
+          }
+        }
+      }
+      if (!have || bc < 2) return false;
+      out_h = bh;
+      out_b = bb;
+      out_m = bm;
+      return true;
+    };
+
+    while (done < num_gates) {
+      bool progressed = true;
+      while (progressed) {
+        progressed = false;
+        std::vector<int64_t> snapshot = ready;
+        for (int64_t g : snapshot)
+          if (try_fold(g)) progressed = true;
+      }
+      if (done == num_gates) break;
+      int64_t h, b, m;
+      if (best_swap(h, b, m)) {
+        plan.emit_segswap(h, b, m);
+        plan.swap_stack.push_back({h, b, m});
+        continue;
+      }
+      int64_t g = ready.front();
       plan.flush();
-      plan.emit_apply(g, phys);
+      plan.emit_apply(g, phys_of(g));
+      pop(g);
     }
     plan.final_restore();
   }
