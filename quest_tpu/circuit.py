@@ -127,6 +127,71 @@ def _eye_cluster():
 # ---------------------------------------------------------------------------
 
 
+_CROSS_RANK = 4  # rank of the |a><b| (x) U_ab decomposition of a 2q gate
+
+
+class _FoldAcc:
+    """Accumulator for the window operator as a rank-R Kronecker sum
+    sum_r B_r (x) A_r (A_r on lanes 0-6, B_r on sublanes 7-13): pure
+    cluster gates multiply into every term; one lane-x-sublane 2q gate
+    raises R from 1 to 4 via its |a><b| block decomposition
+    (fused.apply_cluster_stack executes the sum in one HBM pass).  Shared
+    by the planner (_Plan) and the native-plan materializer."""
+
+    def __init__(self):
+        self.As = [None]  # per-rank traced (2,128,128); None = identity
+        self.Bs = [None]
+        self.rank = 1
+        self.count = 0
+
+    def fold(self, cluster: str, bits: Tuple[int, ...], mat):
+        e = embed_in_cluster(mat, bits)
+        accs = self.As if cluster == "A" else self.Bs
+        for r in range(self.rank):
+            accs[r] = e if accs[r] is None else soa_matmul(e, accs[r])
+        self.count += 1
+
+    def fold_cross(self, phys: Tuple[int, ...], mat):
+        """Fold a 2q gate with one lane and one sublane target; requires
+        rank == 1 (caller flushes first otherwise)."""
+        assert self.rank == 1
+        mat = jnp.asarray(mat)
+        if phys[0] < LANE:
+            la, sb = phys[0], phys[1]
+            def block(a, b):
+                return mat[:, 2 * a:2 * a + 2, 2 * b:2 * b + 2]
+        else:
+            sb, la = phys[0], phys[1]
+            def block(a, b):
+                return mat[:, a::2, b::2]
+        A0, B0 = self.As[0], self.Bs[0]
+        As, Bs = [], []
+        for a in (0, 1):
+            for b in (0, 1):
+                ea = embed_in_cluster(block(a, b), (la,))
+                eb_np = np.zeros((2, 2, 2))
+                eb_np[0, a, b] = 1.0
+                eb = embed_in_cluster(eb_np, (sb - LANE,))
+                As.append(ea if A0 is None else soa_matmul(ea, A0))
+                Bs.append(eb if B0 is None else soa_matmul(eb, B0))
+        self.As, self.Bs = As, Bs
+        self.rank = _CROSS_RANK
+        self.count += 1
+
+    def stacks(self):
+        eye = _eye_cluster()
+        a = jnp.stack([x if x is not None else jnp.asarray(eye)
+                       for x in self.As])
+        b = jnp.stack([x if x is not None else jnp.asarray(eye)
+                       for x in self.Bs])
+        return a, b
+
+    def reset(self):
+        self.As, self.Bs = [None], [None]
+        self.rank = 1
+        self.count = 0
+
+
 class _Plan:
     """Mutable planning state; emits the op program."""
 
@@ -135,9 +200,7 @@ class _Plan:
         # pos[logical qubit] = current physical position
         self.pos = list(range(num_qubits))
         self.ops: List[tuple] = []
-        self.accA = None  # traced (2,128,128) or None
-        self.accB = None
-        self.count = 0  # gates folded since last flush
+        self.acc = _FoldAcc()
         # relocation segment (page) size bounds: m <= seg_max by available
         # high bits; m >= seg_min = 3 keeps the 2^m segment axis a multiple
         # of the 8-sublane tile (no transpose padding) except when fewer
@@ -147,24 +210,14 @@ class _Plan:
         self.swap_stack: List[Tuple[int, int, int]] = []  # (h, b, m)
 
     def _fold(self, cluster: str, bits: Tuple[int, ...], mat):
-        e = embed_in_cluster(mat, bits)
-        acc = self.accA if cluster == "A" else self.accB
-        acc = e if acc is None else soa_matmul(e, acc)
-        if cluster == "A":
-            self.accA = acc
-        else:
-            self.accB = acc
-        self.count += 1
+        self.acc.fold(cluster, bits, mat)
 
     def flush(self):
-        if self.count == 0:
+        if self.acc.count == 0:
             return
-        eye = _eye_cluster()
-        a = self.accA if self.accA is not None else eye
-        b = self.accB if self.accB is not None else eye
+        a, b = self.acc.stacks()
         self.ops.append(("fused", a, b))
-        self.accA = self.accB = None
-        self.count = 0
+        self.acc.reset()
 
     def _emit_segswap(self, h: int, b: int, m: int):
         """Exchange bit segments [h, h+m) <-> [b, b+m)."""
@@ -196,22 +249,36 @@ def _cluster_of(phys: Sequence[int]) -> Optional[str]:
     return None
 
 
+def _is_cross2(phys: Sequence[int]) -> bool:
+    """2q gate with one lane (0-6) and one sublane (7-13) target — foldable
+    as a rank-4 Kronecker sum (_Plan._fold_cross)."""
+    if len(phys) != 2:
+        return False
+    a, b = phys
+    return (a < LANE <= b < WINDOW) or (b < LANE <= a < WINDOW)
+
+
 def materialize_plan(structural: Sequence[tuple],
                      gates: Sequence[Gate]) -> List[tuple]:
     """Turn a structural plan (gate indices, from the native C++ scheduler)
-    into the executable op list by folding the referenced gate matrices."""
+    into the executable op list by folding the referenced gate matrices.
+
+    Fused ops carry an ordered entry list [(side, gate_idx, bits), ...]
+    with side 0 = lane cluster A, 1 = sublane cluster B, 2 = cross
+    (bits = the two physical targets); replayed through _FoldAcc so the
+    result is numerically identical to the Python planner's."""
     ops: List[tuple] = []
-    eye = _eye_cluster()
     for op in structural:
         if op[0] == "fused":
-            mats = []
-            for side in (op[1], op[2]):
-                acc = None
-                for gi, bits in side:
-                    e = embed_in_cluster(gates[gi].mat, bits)
-                    acc = e if acc is None else soa_matmul(e, acc)
-                mats.append(eye if acc is None else acc)
-            ops.append(("fused", mats[0], mats[1]))
+            acc = _FoldAcc()
+            for side, gi, bits in op[1]:
+                if side == 2:
+                    acc.fold_cross(tuple(bits), gates[gi].mat)
+                else:
+                    acc.fold("A" if side == 0 else "B", tuple(bits),
+                             gates[gi].mat)
+            a, b = acc.stacks()
+            ops.append(("fused", a, b))
         elif op[0] == "apply":
             ops.append(("apply", op[2], gates[op[1]].mat))
         else:
@@ -289,12 +356,18 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
     def try_fold(gi):
         phys = phys_of(gi)
         cl = _cluster_of(phys)
-        if cl is None:
-            return False
-        bits = tuple(p if cl == "A" else p - LANE for p in phys)
-        plan._fold(cl, bits, glist[gi].mat)
-        pop(gi)
-        return True
+        if cl is not None:
+            bits = tuple(p if cl == "A" else p - LANE for p in phys)
+            plan._fold(cl, bits, glist[gi].mat)
+            pop(gi)
+            return True
+        if _is_cross2(phys):
+            if plan.acc.rank > 1:
+                plan.flush()
+            plan.acc.fold_cross(phys, glist[gi].mat)
+            pop(gi)
+            return True
+        return False
 
     def swapped_pos(p, h, b, m):
         if b <= p < b + m:
@@ -349,7 +422,7 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
                 count = 0
                 for gi in ready:
                     pp = tuple(swapped_pos(p, h, b, m) for p in phys_of(gi))
-                    if _cluster_of(pp) is not None:
+                    if _cluster_of(pp) is not None or _is_cross2(pp):
                         count += 1
                 evict = min(
                     (next_use.get(p, _LOOKAHEAD + 1) for p in range(b, b + m)),
@@ -390,7 +463,7 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
     n = num_qubits
     for op in ops:
         if op[0] == "fused":
-            amps = fused.apply_cluster_pair(
+            amps = fused.apply_cluster_stack(
                 amps, jnp.asarray(op[1], amps.dtype), jnp.asarray(op[2], amps.dtype),
                 num_qubits=n, interpret=interpret,
             )

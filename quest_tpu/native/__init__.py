@@ -87,7 +87,7 @@ def plan_native(target_lists: Sequence[Sequence[int]],
     """Run the C++ planner over gate target lists.
 
     Returns a *structural* plan — ops referencing gates by index:
-      ('fused', [(gate_idx, bits), ...A], [(gate_idx, bits), ...B])
+      ('fused', [(side, gate_idx, bits), ...])   side: 0=A, 1=B, 2=cross
       ('apply', gate_idx, phys_targets)
       ('segswap', a, b, m)
     or None when the native library is unavailable.
@@ -124,16 +124,14 @@ def plan_native(target_lists: Sequence[Sequence[int]],
     for _ in range(int(data[0])):
         kind = int(data[i]); i += 1
         if kind == 0:
-            folds = []
-            for _side in range(2):
-                nf = int(data[i]); i += 1
-                side = []
-                for _f in range(nf):
-                    gi = int(data[i]); k = int(data[i + 1]); i += 2
-                    bits = tuple(int(b) for b in data[i:i + k]); i += k
-                    side.append((gi, bits))
-                folds.append(side)
-            ops.append(("fused", folds[0], folds[1]))
+            nf = int(data[i]); i += 1
+            entries = []
+            for _f in range(nf):
+                side = int(data[i]); gi = int(data[i + 1])
+                k = int(data[i + 2]); i += 3
+                bits = tuple(int(b) for b in data[i:i + k]); i += k
+                entries.append((side, gi, bits))
+            ops.append(("fused", entries))
         elif kind == 1:
             gi = int(data[i]); k = int(data[i + 1]); i += 2
             phys = tuple(int(p) for p in data[i:i + k]); i += k

@@ -20,8 +20,11 @@
 //
 // Plan serialization (int64 stream):
 //   [num_ops] then per op:
-//     kind 0 (fused):   0, nA, {gate_idx, k, bits[k]} * nA,
-//                          nB, {gate_idx, k, bits[k]} * nB
+//     kind 0 (fused):   0, nEntries, {side, gate_idx, k, bits[k]} * nEntries
+//                       side 0 = lane cluster A fold, 1 = sublane cluster B
+//                       fold, 2 = lane-x-sublane cross fold (bits = the two
+//                       physical targets; raises the Kronecker rank to 4 —
+//                       see circuit._FoldAcc)
 //     kind 1 (apply):   1, gate_idx, k, phys_targets[k]
 //     kind 2 (permute): 2, n, perm[n]       (perm[new_pos] = old_pos; legacy)
 //     kind 3 (segswap): 3, a, b, m          (swap bit segments [a,a+m) and
@@ -42,15 +45,19 @@ constexpr int kWindow = 14;  // qubits 0..13 -> the fused window
 constexpr int64_t kLookahead = 256;  // next-use horizon for eviction choice
 
 struct Fold {
+  int64_t side;  // 0 = cluster A, 1 = cluster B, 2 = cross
   int64_t gate;
   std::vector<int64_t> bits;
 };
+
+constexpr int64_t kCrossRank = 4;
 
 struct Plan {
   std::vector<int64_t> buf;  // serialized ops (without leading count)
   int64_t num_ops = 0;
   std::vector<int64_t> pos;  // pos[logical] = physical
-  std::vector<Fold> accA, accB;
+  std::vector<Fold> acc;     // ordered fold entries since last flush
+  int64_t rank = 1;          // Kronecker rank of the accumulated operator
   int64_t n;
   int64_t seg_max, seg_min;  // relocation page size bounds (see circuit.py)
   struct Swap { int64_t h, b, m; };
@@ -65,18 +72,17 @@ struct Plan {
   }
 
   void flush() {
-    if (accA.empty() && accB.empty()) return;
+    if (acc.empty()) return;
     buf.push_back(0);
-    for (auto* acc : {&accA, &accB}) {
-      buf.push_back(static_cast<int64_t>(acc->size()));
-      for (const Fold& f : *acc) {
-        buf.push_back(f.gate);
-        buf.push_back(static_cast<int64_t>(f.bits.size()));
-        buf.insert(buf.end(), f.bits.begin(), f.bits.end());
-      }
+    buf.push_back(static_cast<int64_t>(acc.size()));
+    for (const Fold& f : acc) {
+      buf.push_back(f.side);
+      buf.push_back(f.gate);
+      buf.push_back(static_cast<int64_t>(f.bits.size()));
+      buf.insert(buf.end(), f.bits.begin(), f.bits.end());
     }
-    accA.clear();
-    accB.clear();
+    acc.clear();
+    rank = 1;
     ++num_ops;
   }
 
@@ -123,11 +129,29 @@ int cluster_of(const std::vector<int64_t>& phys) {
   return -1;
 }
 
+// 2q gate with one lane and one sublane target (circuit._is_cross2)
+bool is_cross2(const std::vector<int64_t>& phys) {
+  if (phys.size() != 2) return false;
+  int64_t a = phys[0], b = phys[1];
+  return (a < kLane && b >= kLane && b < kWindow) ||
+         (b < kLane && a >= kLane && a < kWindow);
+}
+
 void fold(Plan& plan, int cl, int64_t gate, const std::vector<int64_t>& phys) {
   Fold f;
+  f.side = cl;
   f.gate = gate;
   for (int64_t p : phys) f.bits.push_back(cl == 0 ? p : p - kLane);
-  (cl == 0 ? plan.accA : plan.accB).push_back(std::move(f));
+  plan.acc.push_back(std::move(f));
+}
+
+void fold_cross(Plan& plan, int64_t gate, const std::vector<int64_t>& phys) {
+  Fold f;
+  f.side = 2;
+  f.gate = gate;
+  f.bits = phys;  // physical targets in gate order
+  plan.acc.push_back(std::move(f));
+  plan.rank = kCrossRank;
 }
 
 }  // namespace
@@ -194,10 +218,18 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
     auto try_fold = [&](int64_t g) {
       std::vector<int64_t> phys = phys_of(g);
       int cl = cluster_of(phys);
-      if (cl < 0) return false;
-      fold(plan, cl, g, phys);
-      pop(g);
-      return true;
+      if (cl >= 0) {
+        fold(plan, cl, g, phys);
+        pop(g);
+        return true;
+      }
+      if (is_cross2(phys)) {
+        if (plan.rank > 1) plan.flush();
+        fold_cross(plan, g, phys);
+        pop(g);
+        return true;
+      }
+      return false;
     };
 
     auto swapped_pos = [&](int64_t p, int64_t h, int64_t b, int64_t m) {
@@ -255,7 +287,7 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
           for (int64_t g : ready) {
             std::vector<int64_t> pp = phys_of(g);
             for (auto& p : pp) p = swapped_pos(p, h, b, m);
-            if (cluster_of(pp) >= 0) ++count;
+            if (cluster_of(pp) >= 0 || is_cross2(pp)) ++count;
           }
           int64_t evict = kLookahead + 1;
           for (int64_t p = b; p < b + m; ++p)

@@ -76,30 +76,43 @@ def sublane_real_rep(mat_soa):
     return jnp.concatenate([top, bot], axis=0)
 
 
-def _cluster_kernel(a_ref, ma_ref, mb_ref, o_ref):
-    x = a_ref[...]                      # (2, R, 128, 128)
-    xr, xi = x[0], x[1]
-    # lane cluster: right-contract lanes with the 256x256 real rep
-    xc = jnp.concatenate([xr, xi], axis=-1)          # (R, 128, 256)
-    xc = jax.lax.dot_general(
-        xc, ma_ref[...],
-        dimension_numbers=(((2,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                # (R, 128, 256)
-    xr, xi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
-    # sublane cluster: left-contract sublanes
-    yc = jnp.concatenate([xr, xi], axis=1)           # (R, 256, 128)
-    out = jax.lax.dot_general(
-        mb_ref[...], yc,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                # (256, R, 128)
-    out = jnp.moveaxis(out, 0, 1)                    # (R, 256, 128)
-    o_ref[...] = jnp.stack(
-        [out[:, :CLUSTER_DIM], out[:, CLUSTER_DIM:]], axis=0
-    )
+def _cluster_kernel_rank(rank):
+    """Kernel applying sum_r B_r X A_r to each VMEM-resident block: the
+    operator on the 14-qubit window is a rank-``rank`` sum of (sublane op)
+    x (lane op) Kronecker products.  rank=1 is the plain cluster pair;
+    rank=4 absorbs one lane-x-sublane-crossing 2q gate (circuit.py folds
+    the |a><b| (x) U_ab decomposition).  All matmuls hit the MXU; one HBM
+    read + one write regardless of rank."""
+
+    def kernel(a_ref, ma_ref, mb_ref, o_ref):
+        x = a_ref[...]                  # (2, R, 128, 128)  R = block rows
+        xr, xi = x[0], x[1]
+        xc0 = jnp.concatenate([xr, xi], axis=-1)         # (R, 128, 256)
+        acc = None
+        for r in range(rank):
+            # lane op: right-contract lanes with the 256x256 real rep
+            xc = jax.lax.dot_general(
+                xc0, ma_ref[r],
+                dimension_numbers=(((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                            # (R, 128, 256)
+            yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+            # sublane op: left-contract sublanes
+            yc = jnp.concatenate([yr, yi], axis=1)       # (R, 256, 128)
+            out = jax.lax.dot_general(
+                mb_ref[r], yc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                            # (256, R, 128)
+            acc = out if acc is None else acc + out
+        acc = jnp.moveaxis(acc, 0, 1)                    # (R, 256, 128)
+        o_ref[...] = jnp.stack(
+            [acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]], axis=0
+        )
+
+    return kernel
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
@@ -119,28 +132,52 @@ def apply_cluster_pair(
     ``amps``: SoA (2, 2^n), n >= 14.  ``mat_a``/``mat_b``: stacked SoA
     (2, 128, 128) — products of all folded gates, built by circuit.py.
     """
+    return apply_cluster_stack(
+        amps, mat_a[None], mat_b[None], num_qubits=num_qubits,
+        block_rows=block_rows, interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
+         donate_argnums=0)
+def apply_cluster_stack(
+    amps,
+    mats_a,
+    mats_b,
+    *,
+    num_qubits: int,
+    block_rows: int = 8,
+    interpret: bool | None = None,
+):
+    """Apply the rank-R window operator sum_r B_r (x) A_r in one HBM pass.
+
+    ``mats_a``/``mats_b``: stacked SoA (R, 2, 128, 128).  R > 1 encodes
+    lane-x-sublane-crossing gates folded by the scheduler (circuit.py)
+    through the |a><b| block decomposition — the pass costs R matmul pairs
+    but still exactly one state read + write."""
     n = num_qubits
     if n < CLUSTER_QUBITS:
-        raise ValueError(f"apply_cluster_pair needs >= {CLUSTER_QUBITS} qubits")
+        raise ValueError(f"apply_cluster_stack needs >= {CLUSTER_QUBITS} qubits")
     if interpret is None:
         interpret = _interpret_default()
+    rank = mats_a.shape[0]
     nb = 1 << (n - CLUSTER_QUBITS)
     r = min(block_rows, nb)
     while nb % r:
         r //= 2
-    ma = lane_real_rep(jnp.asarray(mat_a, amps.dtype))
-    mb = sublane_real_rep(jnp.asarray(mat_b, amps.dtype))
+    ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
+    mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
     view = amps.reshape(2, nb, CLUSTER_DIM, CLUSTER_DIM)
     out = pl.pallas_call(
-        _cluster_kernel,
+        _cluster_kernel_rank(rank),
         grid=(nb // r,),
         in_specs=[
             pl.BlockSpec((2, r, CLUSTER_DIM, CLUSTER_DIM),
                          lambda i: (0, i, 0, 0)),
-            pl.BlockSpec((2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                         lambda i: (0, 0)),
-            pl.BlockSpec((2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                         lambda i: (0, 0)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i: (0, 0, 0)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((2, r, CLUSTER_DIM, CLUSTER_DIM),
                                lambda i: (0, i, 0, 0)),
