@@ -431,7 +431,10 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
                 key = (count, evict, -m, -h, -b)
                 if best is None or key > best[0]:
                     best = (key, h, b, m)
-        if best is None or best[0][0] < 2:
+        # a swap pass costs the same as one transpose (~copy speed) while a
+        # standalone apply pass is 2-8x that, so relocating for even ONE
+        # foldable gate wins
+        if best is None or best[0][0] < 1:
             return None
         return best[1], best[2], best[3]
 

@@ -310,7 +310,9 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
           }
         }
       }
-      if (!have || bc < 2) return false;
+      // relocating for even one foldable gate beats a standalone apply
+      // pass (see circuit.py best_swap)
+      if (!have || bc < 1) return false;
       out_h = bh;
       out_b = bb;
       out_m = bm;
