@@ -49,6 +49,7 @@ UNSIGNED, TWOS_COMPLEMENT = 0, 1
 # ---------------------------------------------------------------------------
 
 createQuESTEnv = _env.create_quest_env
+initDistributed = _env.init_distributed  # multi-host MPI_Init analogue
 destroyQuESTEnv = _env.destroy_quest_env
 syncQuESTEnv = _env.sync_quest_env
 syncQuESTSuccess = _env.sync_quest_success

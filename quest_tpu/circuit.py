@@ -207,7 +207,6 @@ class _Plan:
         # high bits exist at all
         self.seg_max = min(LANE, max(0, num_qubits - WINDOW))
         self.seg_min = min(3, self.seg_max) if self.seg_max > 0 else 0
-        self.swap_stack: List[Tuple[int, int, int]] = []  # (h, b, m)
 
     def _fold(self, cluster: str, bits: Tuple[int, ...], mat):
         self.acc.fold(cluster, bits, mat)
@@ -234,11 +233,27 @@ class _Plan:
         self.pos = newpos
 
     def final_restore(self):
+        """Return every qubit label to its home position with a MINIMAL
+        greedy block-sort of segment swaps (replaying the whole swap stack
+        in reverse would cost one transpose pass per historical swap; the
+        net permutation usually collapses to a handful)."""
         self.flush()
-        for h, b, m in reversed(self.swap_stack):
-            self._emit_segswap(h, b, m)
-        self.swap_stack = []
-        assert self.pos == list(range(self.n))
+        n = self.n
+        while True:
+            q = next((i for i in range(n) if self.pos[i] != i), None)
+            if q is None:
+                break
+            assert q >= LANE  # lane bits are never relocated
+            p = self.pos[q]  # where logical q currently lives (p > q)
+            m = 1
+            while (
+                q + m < p
+                and q + m < n
+                and p + m < n
+                and self.pos[q + m] == p + m
+            ):
+                m += 1
+            self._emit_segswap(p, q, m)
 
 
 def _cluster_of(phys: Sequence[int]) -> Optional[str]:
@@ -286,6 +301,32 @@ def materialize_plan(structural: Sequence[tuple],
     return ops
 
 
+def _peephole(ops: List[tuple], num_qubits: int) -> List[tuple]:
+    """Merge each segment swap with the cluster pass that follows it into
+    one fused swap+cluster HBM pass (fused.apply_swap_cluster_stack) when
+    the swap's 2^m super-block fits in VMEM."""
+    out: List[tuple] = []
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if (
+            op[0] == "segswap"
+            and i + 1 < len(ops)
+            and ops[i + 1][0] == "fused"
+            and op[3] <= fused.MAX_FUSED_SWAP_M
+            and op[1] >= WINDOW
+            and LANE <= op[2]
+            and op[2] + op[3] <= WINDOW
+        ):
+            out.append(("swapfused", op[1], op[2], op[3],
+                        ops[i + 1][1], ops[i + 1][2]))
+            i += 2
+        else:
+            out.append(op)
+            i += 1
+    return out
+
+
 def plan_circuit(gates: Sequence[Gate], num_qubits: int,
                  use_native: Optional[bool] = None) -> List[tuple]:
     """Plan a gate list: native C++ scheduler when built (see native/),
@@ -297,7 +338,7 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
     if use_native:
         structural = native.plan_native([g.targets for g in gates], num_qubits)
         if structural is not None:
-            return materialize_plan(structural, gates)
+            return _peephole(materialize_plan(structural, gates), num_qubits)
     return plan_circuit_py(gates, num_qubits)
 
 
@@ -451,14 +492,13 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
         if sw is not None:
             h, b, m = sw
             plan._emit_segswap(h, b, m)
-            plan.swap_stack.append((h, b, m))
             continue
         gi = ready[0]
         plan.flush()
         plan.ops.append(("apply", phys_of(gi), glist[gi].mat))
         pop(gi)
     plan.final_restore()
-    return plan.ops
+    return _peephole(plan.ops, n)
 
 
 def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
@@ -479,6 +519,13 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
             amps = kernels.swap_bit_segments(
                 amps, num_qubits=n, a=op[1], b=op[2], m=op[3]
             )
+        elif op[0] == "swapfused":
+            amps = fused.apply_swap_cluster_stack(
+                amps, jnp.asarray(op[4], amps.dtype),
+                jnp.asarray(op[5], amps.dtype),
+                num_qubits=n, h=op[1], b=op[2], m=op[3],
+                interpret=interpret,
+            )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
         else:  # pragma: no cover
@@ -498,6 +545,6 @@ def stats(ops: Sequence[tuple]) -> dict:
     from collections import Counter
 
     c = Counter(op[0] for op in ops)
-    return {"fused": c.get("fused", 0), "apply": c.get("apply", 0),
-            "segswap": c.get("segswap", 0), "permute": c.get("permute", 0),
-            "total_passes": sum(c.values())}
+    return {"fused": c.get("fused", 0), "swapfused": c.get("swapfused", 0),
+            "apply": c.get("apply", 0), "segswap": c.get("segswap", 0),
+            "permute": c.get("permute", 0), "total_passes": sum(c.values())}

@@ -51,6 +51,24 @@ class QuESTEnv:
         return NamedSharding(self.mesh, PartitionSpec())
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host run — the analogue of the reference's ``MPI_Init``
+    (QuEST_cpu_distributed.c:129-160).  Call once per host BEFORE
+    ``create_quest_env``; afterwards ``jax.devices()`` spans every host and
+    the amplitude mesh covers the whole slice (collectives ride ICI within
+    a slice and DCN across slices).  On TPU pods all arguments are
+    auto-detected from the environment."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
 def create_quest_env(
     devices: Optional[Sequence[jax.Device]] = None,
     num_devices: Optional[int] = None,

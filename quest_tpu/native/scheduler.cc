@@ -60,8 +60,6 @@ struct Plan {
   int64_t rank = 1;          // Kronecker rank of the accumulated operator
   int64_t n;
   int64_t seg_max, seg_min;  // relocation page size bounds (see circuit.py)
-  struct Swap { int64_t h, b, m; };
-  std::vector<Swap> swap_stack;
 
   explicit Plan(int64_t n_) : pos(n_), n(n_) {
     for (int64_t q = 0; q < n; ++q) pos[q] = q;
@@ -101,11 +99,21 @@ struct Plan {
     }
   }
 
+  // Greedy block-sort back to identity (mirrors _Plan.final_restore): the
+  // net permutation collapses to a handful of segment swaps instead of a
+  // reverse replay of the whole swap history.
   void final_restore() {
     flush();
-    for (auto it = swap_stack.rbegin(); it != swap_stack.rend(); ++it)
-      emit_segswap(it->h, it->b, it->m);
-    swap_stack.clear();
+    for (;;) {
+      int64_t q = -1;
+      for (int64_t i = 0; i < n; ++i)
+        if (pos[i] != i) { q = i; break; }
+      if (q < 0) break;
+      int64_t p = pos[q];
+      int64_t m = 1;
+      while (q + m < p && q + m < n && p + m < n && pos[q + m] == p + m) ++m;
+      emit_segswap(p, q, m);
+    }
   }
 
   void emit_apply(int64_t gate, const std::vector<int64_t>& phys) {
@@ -331,7 +339,6 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
       int64_t h, b, m;
       if (best_swap(h, b, m)) {
         plan.emit_segswap(h, b, m);
-        plan.swap_stack.push_back({h, b, m});
         continue;
       }
       int64_t g = ready.front();

@@ -51,6 +51,12 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Largest segment width whose 2^m-block super-block (plus the kernel's
+# transpose/concat temporaries) fits in the 16 MB scoped VMEM for the fused
+# swap+cluster kernel (8 blocks = 1 MB per buffer; m=4 overflows).
+MAX_FUSED_SWAP_M = 3
+
+
 def lane_real_rep(mat_soa):
     """(2,128,128) SoA cluster matrix -> (256,256) real right-multiplier.
 
@@ -136,6 +142,97 @@ def apply_cluster_pair(
         amps, mat_a[None], mat_b[None], num_qubits=num_qubits,
         block_rows=block_rows, interpret=interpret,
     )
+
+
+def _cluster_swap_kernel(rank, m, b_local):
+    """Kernel fusing a bit-segment swap [h, h+m) <-> [b, b+m) (b in the
+    sublane range, h in the grid range) with a rank-``rank`` cluster pass:
+    the 2^m source blocks of the swap arrive as one VMEM super-block, the
+    sublane/grid bit exchange is a free in-VMEM transpose, and the cluster
+    matmuls run on the swapped data — one HBM read + write for what was
+    previously a transpose pass plus a cluster pass."""
+    M = 1 << m
+
+    def kernel(a_ref, ma_ref, mb_ref, o_ref):
+        x = a_ref[...]                   # (2, 1, M, 1, 128, 128)
+        x = x.reshape(2, M, CLUSTER_DIM, CLUSTER_DIM)
+        rhi = CLUSTER_DIM >> (b_local + m)
+        rlo = 1 << b_local
+        y = x.reshape(2, M, rhi, M, rlo, CLUSTER_DIM)
+        y = jnp.transpose(y, (0, 3, 2, 1, 4, 5))   # grid bits <-> sublane bits
+        x = y.reshape(2, M, CLUSTER_DIM, CLUSTER_DIM)
+        xr, xi = x[0], x[1]
+        xc0 = jnp.concatenate([xr, xi], axis=-1)
+        acc = None
+        for r in range(rank):
+            xc = jax.lax.dot_general(
+                xc0, ma_ref[r],
+                dimension_numbers=(((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+            yc = jnp.concatenate([yr, yi], axis=1)
+            out = jax.lax.dot_general(
+                mb_ref[r], yc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            acc = out if acc is None else acc + out
+        acc = jnp.moveaxis(acc, 0, 1)
+        out = jnp.stack([acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]], axis=0)
+        o_ref[...] = out.reshape(2, 1, M, 1, CLUSTER_DIM, CLUSTER_DIM)
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "h", "b", "m", "interpret"),
+         donate_argnums=0)
+def apply_swap_cluster_stack(
+    amps,
+    mats_a,
+    mats_b,
+    *,
+    num_qubits: int,
+    h: int,
+    b: int,
+    m: int,
+    interpret: bool | None = None,
+):
+    """Segment swap [h, h+m) <-> [b, b+m) followed by the rank-R window
+    operator sum_r B_r (x) A_r, in ONE HBM pass (see _cluster_swap_kernel).
+    Requires h >= 14, 7 <= b and b + m <= 14, m <= MAX_FUSED_SWAP_M."""
+    n = num_qubits
+    if interpret is None:
+        interpret = _interpret_default()
+    rank = mats_a.shape[0]
+    M = 1 << m
+    nb = 1 << (n - CLUSTER_QUBITS)
+    glo = 1 << (h - CLUSTER_QUBITS)
+    ghi = nb // (glo * M)
+    ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
+    mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
+    view = amps.reshape(2, ghi, M, glo, CLUSTER_DIM, CLUSTER_DIM)
+    out = pl.pallas_call(
+        _cluster_swap_kernel(rank, m, b - LANE_QUBITS),
+        grid=(ghi, glo),
+        in_specs=[
+            pl.BlockSpec((2, 1, M, 1, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, i, 0, j, 0, 0)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, 1, M, 1, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i, j: (0, i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, ma, mb)
+    return out.reshape(2, -1)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
