@@ -1,28 +1,44 @@
-"""Circuit scheduler: fold gate streams into fused cluster passes.
+"""Circuit scheduler: fold gate streams into fused window passes.
 
 The reference executes circuits gate-at-a-time through its dispatch layer
 (QuEST/src/QuEST.c) — every gate is one full sweep of the amplitude array.
 This module is the TPU-native replacement for that dispatch loop: a
-*scheduler* that plans a whole gate list into a short program of
+*scheduler* that plans a whole gate list into a short program of HBM
+passes.  The DEFAULT planner (plan_circuit_windowed) emits
 
-    ('fused',   matA, matB)   one HBM pass applying two 7-qubit cluster
-                              unitaries (ops/fused.py Pallas kernel)
-    ('apply',   targets, mat) fallback standard kernel (cluster-spanning
-                              gates, e.g. a CNOT across the 6/7 boundary)
-    ('segswap', a, b, m)      exchange bit segments [a,a+m) <-> [b,b+m):
-                              pulls a whole 7-bit page of high qubits into
-                              the sublane window as ONE tile-aligned
-                              transpose — the single-chip analogue of the
-                              reference's distributed SWAP-relocalization
+    ('winfused', k, As, Bs, apply_a, apply_b)
+                              one zero-relocation HBM pass applying the
+                              rank-R operator sum_r B_r (x) A_r with A on
+                              lane qubits [0,7) and B on the contiguous
+                              window [k, k+7) — k is chosen per pass, so
+                              high qubits are reached by AIMING the window
+                              at them (ops/fused.py apply_window_stack)
+    ('apply',   targets, mat) fallback standard kernel (gates no window
+                              covers, e.g. a dense 2q gate on two
+                              far-apart high qubits)
+
+2q gates straddling lane x window fold through their operator-Schmidt
+terms (schmidt_terms_2q): rank x2 for controlled gates, x4 generically,
+capped at RANK_CAP per pass.
+
+The legacy 'paged' planner (plan_circuit_py, QT_PLANNER=paged) instead
+pins the window to [7,14) and relocates high qubits into it:
+
+    ('fused',    matA, matB)  cluster pass on qubits [0,14)
+    ('swapfused', h, b, m, As, Bs)  segment swap fused into a cluster pass
+    ('segswap',  a, b, m)     exchange bit segments [a,a+m) <-> [b,b+m) as
+                              ONE tile-aligned transpose — the single-chip
+                              analogue of the reference's distributed
+                              SWAP-relocalization
                               (QuEST_cpu_distributed.c:1503-1545)
 
 Planning is pure Python over *static* gate structure (targets), so it runs
 once at trace time; gate matrices stay traced values, so parameterised
 circuits recompile only when their shape changes, never when angles change.
 
-The same planning algorithm is implemented natively in C++
+Both planning algorithms are implemented natively in C++
 (native/scheduler.cc) for large gate streams; plan_circuit() transparently
-uses it when the native library is built (see native/__init__.py).
+uses the native planner when the library is built (see native/__init__.py).
 """
 
 from __future__ import annotations
@@ -123,6 +139,57 @@ def _eye_cluster():
 
 
 # ---------------------------------------------------------------------------
+# Operator-Schmidt decomposition of concrete 2q gates (cross folds)
+# ---------------------------------------------------------------------------
+
+
+_SCHMIDT_TOL = 1e-7
+
+
+_schmidt_cache: dict = {}
+
+
+def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
+    """Operator-Schmidt decomposition of a CONCRETE SoA (2,4,4) 2q gate:
+    U = sum_r hi_r (x) lo_r over (matrix bit 1, matrix bit 0).  Returns
+    [(lo_soa, hi_soa), ...] (each SoA (2,2,2)) with len = the operator
+    Schmidt rank — 1 for product gates, 2 for CNOT/CZ/controlled-phase,
+    4 generically — or None for traced matrices (rank unknowable at plan
+    time).  Cuts the cross-fold rank of the dominant controlled gates from
+    4 to 2 vs the generic |a><b| decomposition."""
+    if isinstance(mat_soa, jax.core.Tracer):
+        return None
+    try:
+        m = np.asarray(mat_soa)
+    except Exception:  # pragma: no cover - any non-materializable value
+        return None
+    if m.dtype == object or m.shape != (2, 4, 4):
+        return None
+    key = (m.dtype.str, m.tobytes())
+    hit = _schmidt_cache.get(key)
+    if hit is not None:
+        return hit
+    u = m[0] + 1j * m[1]
+    # row index = 2*b1 + b0; regroup to T[(b1,b1'),(b0,b0')]
+    t = u.reshape(2, 2, 2, 2).transpose(0, 2, 1, 3).reshape(4, 4)
+    uu, s, vh = np.linalg.svd(t)
+    terms = []
+    for r in range(4):
+        if s[r] <= _SCHMIDT_TOL:
+            continue
+        hi = (np.sqrt(s[r]) * uu[:, r]).reshape(2, 2)
+        lo = (np.sqrt(s[r]) * vh[r, :]).reshape(2, 2)
+        terms.append(
+            (
+                np.stack([lo.real, lo.imag]).astype(m.dtype),
+                np.stack([hi.real, hi.imag]).astype(m.dtype),
+            )
+        )
+    _schmidt_cache[key] = terms
+    return terms
+
+
+# ---------------------------------------------------------------------------
 # Scheduler
 # ---------------------------------------------------------------------------
 
@@ -190,6 +257,79 @@ class _FoldAcc:
         self.As, self.Bs = [None], [None]
         self.rank = 1
         self.count = 0
+
+
+class _WinAcc:
+    """Accumulator for one offset-window pass: the operator on
+    {lane qubits [0,7)} x {window qubits [k, k+7)} as a rank-R Kronecker
+    sum sum_r B_r (x) A_r.  Like _FoldAcc but bound to a window offset and
+    using the operator-Schmidt decomposition for concrete cross gates
+    (rank x2 for CNOT/CZ instead of x4), with rank capped by the planner."""
+
+    def __init__(self, k: int):
+        self.k = k
+        self.As: List[Optional[object]] = [None]
+        self.Bs: List[Optional[object]] = [None]
+        self.rank = 1
+        self.count = 0
+        self.a_used = False
+        self.b_used = False
+
+    def fold_side(self, side: str, bits: Tuple[int, ...], mat):
+        e = embed_in_cluster(mat, bits)
+        accs = self.As if side == "A" else self.Bs
+        for r in range(self.rank):
+            accs[r] = e if accs[r] is None else soa_matmul(e, accs[r])
+        if side == "A":
+            self.a_used = True
+        else:
+            self.b_used = True
+        self.count += 1
+
+    def fold_cross(self, lane_bit: int, win_bit: int, mat,
+                   lane_is_bit0: bool):
+        """Fold a 2q gate with one lane target and one window target.
+        ``win_bit`` is window-relative (0-6).  Concrete matrices use their
+        Schmidt terms; traced matrices the generic 4-term |a><b| split."""
+        terms = schmidt_terms_2q(mat)
+        if terms is not None:
+            pairs = [
+                (lo, hi) if lane_is_bit0 else (hi, lo) for lo, hi in terms
+            ]
+        else:
+            mat = jnp.asarray(mat)
+            pairs = []
+            for a in (0, 1):
+                for b in (0, 1):
+                    if lane_is_bit0:
+                        lane_m = mat[:, 2 * a:2 * a + 2, 2 * b:2 * b + 2]
+                    else:
+                        lane_m = mat[:, a::2, b::2]
+                    win_m = np.zeros((2, 2, 2))
+                    win_m[0, a, b] = 1.0
+                    pairs.append((lane_m, win_m))
+        As, Bs = [], []
+        for lane_m, win_m in pairs:
+            ea = embed_in_cluster(lane_m, (lane_bit,))
+            eb = embed_in_cluster(win_m, (win_bit,))
+            for r in range(self.rank):
+                As.append(ea if self.As[r] is None
+                          else soa_matmul(ea, self.As[r]))
+                Bs.append(eb if self.Bs[r] is None
+                          else soa_matmul(eb, self.Bs[r]))
+        self.As, self.Bs = As, Bs
+        self.rank = len(As)
+        self.a_used = True
+        self.b_used = True
+        self.count += 1
+
+    def stacks(self):
+        eye = _eye_cluster()
+        a = jnp.stack([x if x is not None else jnp.asarray(eye)
+                       for x in self.As])
+        b = jnp.stack([x if x is not None else jnp.asarray(eye)
+                       for x in self.Bs])
+        return a, b
 
 
 class _Plan:
@@ -328,11 +468,34 @@ def _peephole(ops: List[tuple], num_qubits: int) -> List[tuple]:
 
 
 def plan_circuit(gates: Sequence[Gate], num_qubits: int,
-                 use_native: Optional[bool] = None) -> List[tuple]:
-    """Plan a gate list: native C++ scheduler when built (see native/),
-    Python fallback otherwise — identical algorithm and output."""
+                 use_native: Optional[bool] = None,
+                 planner: Optional[str] = None) -> List[tuple]:
+    """Plan a gate list.
+
+    ``planner``: 'windowed' (default — offset-window passes, zero
+    relocation) or 'paged' (the segswap-relocation scheduler).  Overridable
+    via QT_PLANNER.  The native C++ scheduler (native/scheduler.cc) is used
+    when built; Python fallback otherwise — identical algorithm/output."""
+    import os
+
     from . import native
 
+    if planner is None:
+        planner = os.environ.get("QT_PLANNER", "windowed")
+    if planner not in ("windowed", "paged"):
+        raise ValueError(
+            f"unknown planner {planner!r}: expected 'windowed' or 'paged'"
+        )
+    if planner == "windowed":
+        if use_native is None:
+            use_native = native.native_available()
+        if use_native:
+            structural = native.plan_native_windowed(
+                [g.targets for g in gates], num_qubits,
+                _gate_xranks(gates))
+            if structural is not None:
+                return materialize_windowed_plan(structural, gates)
+        return plan_circuit_windowed(gates, num_qubits)
     if use_native is None:
         use_native = native.native_available()
     if use_native:
@@ -340,6 +503,47 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
         if structural is not None:
             return _peephole(materialize_plan(structural, gates), num_qubits)
     return plan_circuit_py(gates, num_qubits)
+
+
+def _gate_xranks(gates: Sequence[Gate]) -> List[int]:
+    """Per-gate cross-fold rank for the native planner: Schmidt rank for
+    concrete 2q matrices, 4 for traced 2q matrices, 0 otherwise."""
+    out = []
+    for g in gates:
+        if len(g.targets) == 2:
+            terms = schmidt_terms_2q(g.mat)
+            out.append(len(terms) if terms is not None else _CROSS_RANK)
+        else:
+            out.append(0)
+    return out
+
+
+def materialize_windowed_plan(structural: Sequence[tuple],
+                              gates: Sequence[Gate]) -> List[tuple]:
+    """Structural windowed plan (from native/scheduler.cc) -> executable op
+    list.  Winfused ops carry (k, [(kind, gate_idx, bits), ...]) with kind
+    0 = lane side A, 1 = window side B, 2 = cross (bits = (lane_bit,
+    win_bit, lane_is_bit0)); replayed through _WinAcc so the result is
+    numerically identical to the Python planner's."""
+    ops: List[tuple] = []
+    for op in structural:
+        if op[0] == "winfused":
+            k, entries = op[1], op[2]
+            acc = _WinAcc(k)
+            for kind, gi, bits in entries:
+                if kind == 2:
+                    acc.fold_cross(bits[0], bits[1], gates[gi].mat,
+                                   bool(bits[2]))
+                else:
+                    acc.fold_side("A" if kind == 0 else "B", tuple(bits),
+                                  gates[gi].mat)
+            a, b = acc.stacks()
+            ops.append(("winfused", k, a, b, acc.a_used, acc.b_used))
+        elif op[0] == "apply":
+            ops.append(("apply", op[2], gates[op[1]].mat))
+        else:
+            ops.append(op)
+    return ops
 
 
 def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
@@ -501,6 +705,141 @@ def plan_circuit_py(gates: Sequence[Gate], num_qubits: int) -> List[tuple]:
     return _peephole(plan.ops, n)
 
 
+RANK_CAP = 4  # max Kronecker-sum rank per window pass (FLOPs scale with it)
+
+
+def plan_circuit_windowed(gates: Sequence[Gate],
+                          num_qubits: int) -> List[tuple]:
+    """Offset-window DAG list scheduler — zero-relocation planning.
+
+    Each emitted pass applies a rank-R operator on {lane qubits [0,7)} x
+    {window qubits [k, k+7)} where the window offset k is chosen PER PASS:
+    the window kernel (ops/fused.py apply_window_stack) views the strided
+    bit-window directly through its BlockSpec, so high qubits never have to
+    be relocated at all — where the paged planner (plan_circuit_py) pays
+    segswap/transpose passes to pull high qubits into [7,14), this planner
+    just aims the window at them.  The scheduler greedily picks, per pass,
+    the offset k whose transitive fold closure over the ready frontier
+    covers the most gates; 2q gates straddling lane x window fold through
+    their operator-Schmidt terms (schmidt_terms_2q — rank x2 for
+    controlled gates) with pass rank capped at RANK_CAP.  Gates no window
+    covers (e.g. a dense 2q gate on two far-apart high qubits) fall back to
+    one standard layout-safe kernel pass."""
+    n = num_qubits
+    glist = list(gates)
+    if n < WINDOW:
+        return [("apply", g.targets, g.mat) for g in glist]
+
+    num_gates = len(glist)
+    queues: List[List[int]] = [[] for _ in range(n)]
+    for gi, g in enumerate(glist):
+        for t in g.targets:
+            queues[t].append(gi)
+    heads = [0] * n
+
+    # cross-fold rank per 2q gate: Schmidt rank when concrete, 4 otherwise
+    xrank = _gate_xranks(glist)
+
+    k_lo, k_hi = LANE, n - LANE  # valid window offsets (inclusive)
+
+    def classify(targets: Tuple[int, ...], k: int):
+        """How ``targets`` folds for window [k, k+7): ('A', bits),
+        ('B', window-relative bits), ('X', lane_bit, win_bit, lane_is_bit0)
+        for a 2q lane x window straddle, or None."""
+        lane = all(t < LANE for t in targets)
+        if lane:
+            return ("A", targets)
+        win = all(k <= t < k + LANE for t in targets)
+        if win:
+            return ("B", tuple(t - k for t in targets))
+        if len(targets) == 2:
+            t0, t1 = targets
+            if t0 < LANE and k <= t1 < k + LANE:
+                return ("X", t0, t1 - k, True)
+            if t1 < LANE and k <= t0 < k + LANE:
+                return ("X", t1, t0 - k, False)
+        return None
+
+    def is_ready(gi, hd):
+        return all(
+            hd[t] < len(queues[t]) and queues[t][hd[t]] == gi
+            for t in glist[gi].targets
+        )
+
+    ready = sorted(gi for gi in range(num_gates) if is_ready(gi, heads))
+
+    def advance(gi, hd, rdy):
+        """Pop gate gi from (hd, rdy) in place."""
+        for t in glist[gi].targets:
+            hd[t] += 1
+        rdy.remove(gi)
+        for t in glist[gi].targets:
+            if hd[t] < len(queues[t]):
+                cand = queues[t][hd[t]]
+                if cand not in rdy and is_ready(cand, hd):
+                    rdy.append(cand)
+        rdy.sort()
+
+    def simulate(k):
+        """Transitive fold closure for window k over copies of the DAG
+        state: (count, final_rank, folds in fold order)."""
+        hd = heads[:]
+        rdy = list(ready)
+        rank, count, folds = 1, 0, []
+        progressed = True
+        while progressed:
+            progressed = False
+            for gi in list(rdy):
+                c = classify(glist[gi].targets, k)
+                if c is None:
+                    continue
+                if c[0] == "X":
+                    r = xrank[gi]
+                    if rank * r > RANK_CAP:
+                        continue
+                    rank *= r
+                count += 1
+                folds.append(gi)
+                advance(gi, hd, rdy)
+                progressed = True
+        return count, rank, folds
+
+    ops: List[tuple] = []
+    while ready:
+        # candidate offsets: windows that cover some ready gate's high
+        # targets, plus the home window k=7
+        cands = {k_lo}
+        for gi in ready:
+            for t in glist[gi].targets:
+                if t >= LANE:
+                    for k in range(max(k_lo, t - LANE + 1),
+                                   min(k_hi, t) + 1):
+                        cands.add(k)
+        best = None
+        for k in sorted(cands):
+            count, rank, folds = simulate(k)
+            key = (count, -rank, -k)
+            if best is None or key > best[0]:
+                best = (key, k, folds)
+        if best is None or best[0][0] == 0:
+            gi = ready[0]
+            ops.append(("apply", glist[gi].targets, glist[gi].mat))
+            advance(gi, heads, ready)
+            continue
+        _, k, folds = best
+        acc = _WinAcc(k)
+        for gi in folds:
+            c = classify(glist[gi].targets, k)
+            if c[0] == "X":
+                acc.fold_cross(c[1], c[2], glist[gi].mat, c[3])
+            else:
+                acc.fold_side(c[0], c[1], glist[gi].mat)
+            advance(gi, heads, ready)
+        a, b = acc.stacks()
+        ops.append(("winfused", k, a, b, acc.a_used, acc.b_used))
+    return ops
+
+
 def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
                  interpret: Optional[bool] = None):
     n = num_qubits
@@ -526,6 +865,13 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
                 num_qubits=n, h=op[1], b=op[2], m=op[3],
                 interpret=interpret,
             )
+        elif op[0] == "winfused":
+            amps = fused.apply_window_stack(
+                amps, jnp.asarray(op[2], amps.dtype),
+                jnp.asarray(op[3], amps.dtype),
+                num_qubits=n, k=op[1], apply_a=op[4], apply_b=op[5],
+                interpret=interpret,
+            )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
         else:  # pragma: no cover
@@ -546,5 +892,6 @@ def stats(ops: Sequence[tuple]) -> dict:
 
     c = Counter(op[0] for op in ops)
     return {"fused": c.get("fused", 0), "swapfused": c.get("swapfused", 0),
+            "winfused": c.get("winfused", 0),
             "apply": c.get("apply", 0), "segswap": c.get("segswap", 0),
             "permute": c.get("permute", 0), "total_passes": sum(c.values())}

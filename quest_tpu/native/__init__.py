@@ -74,12 +74,86 @@ def get_lib():
         ]
         lib.qts_free.restype = None
         lib.qts_free.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        try:
+            lib.qts_plan_windowed.restype = ctypes.c_int
+            lib.qts_plan_windowed.argtypes = [
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+        except AttributeError:  # older _qts.so without the windowed planner
+            pass
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def plan_native_windowed(target_lists: Sequence[Sequence[int]],
+                         num_qubits: int,
+                         xranks: Sequence[int]) -> Optional[List[tuple]]:
+    """Run the C++ windowed planner (qts_plan_windowed) over gate target
+    lists + per-gate cross ranks.  Returns a structural plan —
+      ('winfused', k, [(kind, gate_idx, bits), ...])  kind: 0=A, 1=B,
+        2=cross with bits=(lane_bit, win_bit, lane_is_bit0)
+      ('apply', gate_idx, targets)
+    — or None when the native library (or entry point) is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "qts_plan_windowed"):
+        return None
+    offsets = np.zeros(len(target_lists) + 1, dtype=np.int64)
+    for i, t in enumerate(target_lists):
+        offsets[i + 1] = offsets[i] + len(t)
+    flat = np.fromiter(
+        (q for t in target_lists for q in t), dtype=np.int64,
+        count=int(offsets[-1]),
+    )
+    if flat.size == 0:
+        flat = np.zeros(1, dtype=np.int64)
+    xr = np.asarray(list(xranks), dtype=np.int64)
+    if xr.size == 0:
+        xr = np.zeros(1, dtype=np.int64)
+    buf = ctypes.POINTER(ctypes.c_int64)()
+    length = ctypes.c_int64()
+    rc = lib.qts_plan_windowed(
+        num_qubits, len(target_lists),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        xr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(buf), ctypes.byref(length),
+    )
+    if rc != 0:
+        return None
+    try:
+        data = np.ctypeslib.as_array(buf, shape=(length.value,)).copy()
+    finally:
+        lib.qts_free(buf)
+
+    ops: List[tuple] = []
+    i = 1
+    for _ in range(int(data[0])):
+        kind = int(data[i]); i += 1
+        if kind == 4:
+            k = int(data[i]); nf = int(data[i + 1]); i += 2
+            entries = []
+            for _f in range(nf):
+                side = int(data[i]); gi = int(data[i + 1])
+                nb = int(data[i + 2]); i += 3
+                bits = tuple(int(b) for b in data[i:i + nb]); i += nb
+                entries.append((side, gi, bits))
+            ops.append(("winfused", k, entries))
+        elif kind == 1:
+            gi = int(data[i]); nt = int(data[i + 1]); i += 2
+            targs = tuple(int(p) for p in data[i:i + nt]); i += nt
+            ops.append(("apply", gi, targs))
+        else:
+            raise ValueError(f"bad windowed plan op kind {kind}")
+    return ops
 
 
 def plan_native(target_lists: Sequence[Sequence[int]],

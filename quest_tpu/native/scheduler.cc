@@ -361,6 +361,229 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
   return 0;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Windowed planner (qts_plan_windowed): offset-window passes, zero
+// relocation.  Mirrors circuit.plan_circuit_windowed line by line (parity
+// asserted by tests/test_circuit.py::TestNativeWindowedScheduler): per pass,
+// greedily pick the window offset k whose transitive fold closure over the
+// ready frontier covers the most gates; 2q lane x window straddles fold at
+// their operator-Schmidt rank (xranks[], computed Python-side from the
+// concrete matrices), with pass rank capped at kRankCap.
+//
+// Serialization (int64 stream): [num_ops] then per op:
+//   kind 4 (winfused): 4, k, nEntries,
+//                      {side, gate_idx, nbits, bits[nbits]} * nEntries
+//                      side 0 = lane A (bits = targets), 1 = window B
+//                      (bits = window-relative targets), 2 = cross
+//                      (bits = lane_bit, win_bit, lane_is_bit0)
+//   kind 1 (apply):    1, gate_idx, nt, targets[nt]
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int64_t kRankCap = 4;  // keep in sync with circuit.RANK_CAP
+
+}  // namespace
+
+extern "C" {
+
+int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
+                      const int64_t* targets, const int64_t* xranks,
+                      int64_t** out_buf, int64_t* out_len) {
+  if (n <= 0 || num_gates < 0 || !offsets || !out_buf || !out_len) return 1;
+  for (int64_t i = 0; i < offsets[num_gates]; ++i)
+    if (targets[i] < 0 || targets[i] >= n) return 3;  // bad target qubit
+
+  std::vector<int64_t> buf;
+  int64_t num_ops = 0;
+
+  auto targs_of = [&](int64_t g) {
+    std::vector<int64_t> t;
+    for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i)
+      t.push_back(targets[i]);
+    return t;
+  };
+
+  auto emit_apply = [&](int64_t g) {
+    buf.push_back(1);
+    buf.push_back(g);
+    auto t = targs_of(g);
+    buf.push_back((int64_t)t.size());
+    buf.insert(buf.end(), t.begin(), t.end());
+    ++num_ops;
+  };
+
+  if (n < kWindow) {
+    for (int64_t g = 0; g < num_gates; ++g) emit_apply(g);
+  } else {
+    const int64_t k_lo = kLane, k_hi = n - kLane;
+
+    std::vector<std::vector<int64_t>> queues(n);
+    for (int64_t g = 0; g < num_gates; ++g)
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i)
+        queues[targets[i]].push_back(g);
+    std::vector<int64_t> heads(n, 0);
+
+    auto is_ready = [&](int64_t g, const std::vector<int64_t>& hd) {
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+        int64_t t = targets[i];
+        if (hd[t] >= (int64_t)queues[t].size() || queues[t][hd[t]] != g)
+          return false;
+      }
+      return true;
+    };
+
+    std::vector<int64_t> ready;
+    for (int64_t g = 0; g < num_gates; ++g)
+      if (is_ready(g, heads)) ready.push_back(g);
+
+    auto advance = [&](int64_t g, std::vector<int64_t>& hd,
+                       std::vector<int64_t>& rdy) {
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) ++hd[targets[i]];
+      rdy.erase(std::find(rdy.begin(), rdy.end(), g));
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+        int64_t t = targets[i];
+        if (hd[t] < (int64_t)queues[t].size()) {
+          int64_t cand = queues[t][hd[t]];
+          if (std::find(rdy.begin(), rdy.end(), cand) == rdy.end() &&
+              is_ready(cand, hd))
+            rdy.push_back(cand);
+        }
+      }
+      std::sort(rdy.begin(), rdy.end());
+    };
+
+    // classification result: kind -1 = none, 0 = A, 1 = B, 2 = cross
+    struct Cls {
+      int kind;
+      int64_t lane_bit, win_bit, lane_is_bit0;  // cross only
+    };
+    auto classify = [&](int64_t g, int64_t k) -> Cls {
+      bool lane = true, win = true;
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+        int64_t t = targets[i];
+        if (t >= kLane) lane = false;
+        if (t < k || t >= k + kLane) win = false;
+      }
+      if (lane) return {0, 0, 0, 0};
+      if (win) return {1, 0, 0, 0};
+      if (offsets[g + 1] - offsets[g] == 2) {
+        int64_t t0 = targets[offsets[g]], t1 = targets[offsets[g] + 1];
+        if (t0 < kLane && t1 >= k && t1 < k + kLane) return {2, t0, t1 - k, 1};
+        if (t1 < kLane && t0 >= k && t0 < k + kLane) return {2, t1, t0 - k, 0};
+      }
+      return {-1, 0, 0, 0};
+    };
+
+    // transitive fold closure for window k over copies of the DAG state
+    auto simulate = [&](int64_t k, std::vector<int64_t>& folds_out,
+                        int64_t& rank_out) -> int64_t {
+      std::vector<int64_t> hd = heads;
+      std::vector<int64_t> rdy = ready;
+      int64_t rank = 1, count = 0;
+      bool progressed = true;
+      while (progressed) {
+        progressed = false;
+        std::vector<int64_t> snapshot = rdy;
+        for (int64_t g : snapshot) {
+          if (std::find(rdy.begin(), rdy.end(), g) == rdy.end()) continue;
+          Cls c = classify(g, k);
+          if (c.kind < 0) continue;
+          if (c.kind == 2) {
+            int64_t r = xranks[g];
+            if (rank * r > kRankCap) continue;
+            rank *= r;
+          }
+          ++count;
+          folds_out.push_back(g);
+          advance(g, hd, rdy);
+          progressed = true;
+        }
+      }
+      rank_out = rank;
+      return count;
+    };
+
+    while (!ready.empty()) {
+      // candidate offsets: windows covering some ready gate's high targets,
+      // plus the home window k=7
+      std::vector<int64_t> cands{k_lo};
+      for (int64_t g : ready)
+        for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i) {
+          int64_t t = targets[i];
+          if (t >= kLane) {
+            int64_t lo = std::max(k_lo, t - kLane + 1);
+            int64_t hi = std::min(k_hi, t);
+            for (int64_t k = lo; k <= hi; ++k)
+              if (std::find(cands.begin(), cands.end(), k) == cands.end())
+                cands.push_back(k);
+          }
+        }
+      std::sort(cands.begin(), cands.end());
+
+      bool have = false;
+      int64_t bcount = 0, brank = 0, bk = 0;
+      std::vector<int64_t> bfolds;
+      for (int64_t k : cands) {
+        std::vector<int64_t> folds;
+        int64_t rank;
+        int64_t count = simulate(k, folds, rank);
+        // lexicographic key (count, -rank, -k), maximized
+        bool better = false;
+        if (!have) better = true;
+        else if (count != bcount) better = count > bcount;
+        else if (rank != brank) better = rank < brank;
+        else if (k != bk) better = k < bk;
+        if (better) {
+          have = true;
+          bcount = count;
+          brank = rank;
+          bk = k;
+          bfolds = std::move(folds);
+        }
+      }
+      if (!have || bcount == 0) {
+        int64_t g = ready.front();
+        emit_apply(g);
+        advance(g, heads, ready);
+        continue;
+      }
+      buf.push_back(4);
+      buf.push_back(bk);
+      buf.push_back((int64_t)bfolds.size());
+      for (int64_t g : bfolds) {
+        Cls c = classify(g, bk);
+        buf.push_back(c.kind);
+        buf.push_back(g);
+        if (c.kind == 2) {
+          buf.push_back(3);
+          buf.push_back(c.lane_bit);
+          buf.push_back(c.win_bit);
+          buf.push_back(c.lane_is_bit0);
+        } else {
+          auto t = targs_of(g);
+          buf.push_back((int64_t)t.size());
+          for (int64_t tt : t) buf.push_back(c.kind == 0 ? tt : tt - bk);
+        }
+        advance(g, heads, ready);
+      }
+      ++num_ops;
+    }
+  }
+
+  int64_t len = (int64_t)buf.size() + 1;
+  auto* out = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * len));
+  if (!out) return 2;
+  out[0] = num_ops;
+  if (!buf.empty())
+    std::memcpy(out + 1, buf.data(), sizeof(int64_t) * buf.size());
+  *out_buf = out;
+  *out_len = len;
+  return 0;
+}
+
 void qts_free(int64_t* buf) { std::free(buf); }
 
 }  // extern "C"

@@ -100,7 +100,7 @@ def _cluster_kernel_rank(rank):
             xc = jax.lax.dot_general(
                 xc0, ma_ref[r],
                 dimension_numbers=(((2,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=x.dtype,
                 precision=jax.lax.Precision.HIGHEST,
             )                                            # (R, 128, 256)
             yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
@@ -109,7 +109,7 @@ def _cluster_kernel_rank(rank):
             out = jax.lax.dot_general(
                 mb_ref[r], yc,
                 dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=x.dtype,
                 precision=jax.lax.Precision.HIGHEST,
             )                                            # (256, R, 128)
             acc = out if acc is None else acc + out
@@ -168,7 +168,7 @@ def _cluster_swap_kernel(rank, m, b_local):
             xc = jax.lax.dot_general(
                 xc0, ma_ref[r],
                 dimension_numbers=(((2,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=x.dtype,
                 precision=jax.lax.Precision.HIGHEST,
             )
             yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
@@ -176,7 +176,7 @@ def _cluster_swap_kernel(rank, m, b_local):
             out = jax.lax.dot_general(
                 mb_ref[r], yc,
                 dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=x.dtype,
                 precision=jax.lax.Precision.HIGHEST,
             )
             acc = out if acc is None else acc + out
@@ -228,6 +228,125 @@ def apply_swap_cluster_stack(
         ],
         out_specs=pl.BlockSpec((2, 1, M, 1, CLUSTER_DIM, CLUSTER_DIM),
                                lambda i, j: (0, i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, ma, mb)
+    return out.reshape(2, -1)
+
+
+def _window_kernel(rank, apply_a, apply_b):
+    """Kernel applying sum_r B_r (x) A_r where A_r acts on the lane qubits
+    [0,7) and B_r on an ARBITRARY contiguous sublane window [k, k+7) — the
+    block spec (not the kernel) encodes k.  Block shape (2, R, 128, M, 128):
+    R hi-axis blocks x M mid-axis blocks; both are pure batch axes of the
+    two MXU contractions, so no in-kernel transposes are needed.
+    ``apply_a``/``apply_b`` skip the corresponding matmul when that side of
+    the window operator is identity (half the FLOPs of a full pass)."""
+
+    def kernel(a_ref, ma_ref, mb_ref, o_ref):
+        xflat = a_ref[...]              # (2, R, 128, M*128)
+        x = xflat.reshape(
+            2, xflat.shape[1], CLUSTER_DIM,
+            xflat.shape[3] // CLUSTER_DIM, CLUSTER_DIM,
+        )                               # (2, R, 128, M, 128)
+        xr, xi = x[0], x[1]
+        xc0 = jnp.concatenate([xr, xi], axis=-1)         # (R, 128, M, 256)
+        acc = None
+        for r in range(rank):
+            if apply_a:
+                xc = jax.lax.dot_general(
+                    xc0, ma_ref[r],
+                    dimension_numbers=(((3,), (0,)), ((), ())),
+                    preferred_element_type=x.dtype,
+                    precision=jax.lax.Precision.HIGHEST,
+                )                                        # (R, 128, M, 256)
+            else:
+                xc = xc0
+            yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+            # sublane op: left-contract the window axis (dim 1)
+            yc = jnp.concatenate([yr, yi], axis=1)       # (R, 256, M, 128)
+            if apply_b:
+                out = jax.lax.dot_general(
+                    mb_ref[r], yc,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=x.dtype,
+                    precision=jax.lax.Precision.HIGHEST,
+                )                                        # (256, R, M, 128)
+                out = jnp.moveaxis(out, 0, 1)            # (R, 256, M, 128)
+            else:
+                out = yc
+            acc = out if acc is None else acc + out
+        res = jnp.stack(
+            [acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]], axis=0
+        )                               # (2, R, 128, M, 128)
+        o_ref[...] = res.reshape(xflat.shape)
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "k", "apply_a", "apply_b",
+                          "block_amps", "interpret"),
+         donate_argnums=0)
+def apply_window_stack(
+    amps,
+    mats_a,
+    mats_b,
+    *,
+    num_qubits: int,
+    k: int = SUBLANE_QUBITS,
+    apply_a: bool = True,
+    apply_b: bool = True,
+    block_amps: int = 8 * BLOCK_AMPS,
+    interpret: bool | None = None,
+):
+    """Apply the rank-R operator sum_r B_r (x) A_r with A on lane qubits
+    [0,7) and B on the contiguous window [k, k+7), 7 <= k <= n-7, in ONE
+    HBM pass with NO data relocation: the state is viewed as
+    (2, hi, 128, mid, 128) so the window bits land on the sublane axis of
+    each block (strided-row DMA).  k = 7 reproduces apply_cluster_stack;
+    k > 7 replaces a segswap-relocate + cluster + restore sequence — the
+    single-chip analogue of choosing which qubits are "local", cf. the
+    reference's SWAP-relocalization (QuEST_cpu_distributed.c:1503-1545).
+    """
+    n = num_qubits
+    if not (LANE_QUBITS <= k <= n - SUBLANE_QUBITS):
+        raise ValueError(f"window offset {k} out of range for n={n}")
+    if interpret is None:
+        interpret = _interpret_default()
+    rank = mats_a.shape[0]
+    hi = 1 << (n - k - SUBLANE_QUBITS)
+    mid = 1 << (k - LANE_QUBITS)
+    # batch hi first (contiguous super-blocks), then mid, to ~block_amps;
+    # scale down with rank — the unrolled rank loop multiplies the scoped
+    # VMEM for temporaries (observed 18.4M > the 16M limit at rank 4, R 8)
+    block_amps = max(BLOCK_AMPS, block_amps // rank)
+    R = min(hi, max(1, block_amps // BLOCK_AMPS))
+    while hi % R:
+        R //= 2
+    M = min(mid, max(1, block_amps // (R * BLOCK_AMPS)))
+    while mid % M:
+        M //= 2
+    ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
+    mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
+    # 4-d view: the window bits ARE the (second-to-last) sublane tile dim
+    # and the trailing dim is (mid, lane) flattened, so every block shape
+    # (2, R, 128, M*128) satisfies Mosaic's (8, 128) tiling requirement.
+    view = amps.reshape(2, hi, CLUSTER_DIM, mid * CLUSTER_DIM)
+    out = pl.pallas_call(
+        _window_kernel(rank, apply_a, apply_b),
+        grid=(hi // R, mid // M),
+        in_specs=[
+            pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
+                         lambda i, j: (0, i, 0, j)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
+                               lambda i, j: (0, i, 0, j)),
         out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
         input_output_aliases={0: 0},
         interpret=interpret,

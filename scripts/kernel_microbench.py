@@ -1,0 +1,184 @@
+"""Microbenchmark of individual HBM-pass kernels on the real TPU.
+
+Times one pass of each kernel flavor at 26 qubits to find where the
+headline circuit's 91 passes spend their time, and prototypes an
+"offset-window" cluster kernel whose sublane cluster sits at an arbitrary
+contiguous bit window [k, k+7) — a zero-copy alternative to segswap
+relocation (the BlockSpec views the strided rows directly).
+"""
+
+import sys
+import os
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+import jax
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from quest_tpu.ops import fused, kernels
+
+N = int(os.environ.get("QT_MB_QUBITS", "26"))
+REPS = 5
+DIM = fused.CLUSTER_DIM
+LANE = fused.LANE_QUBITS
+
+
+CHAIN = 8
+
+
+def timeit(fn, state):
+    """Per-pass time of a donating state->state kernel: chain CHAIN calls,
+    fetch one element (forces completion through the relay), subtract the
+    measured fetch round-trip, divide."""
+    s = fn(state)            # compile + first run
+    float(s[0, 0])
+    t0 = time.perf_counter()
+    float(s[0, 0])
+    roundtrip = time.perf_counter() - t0
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(CHAIN):
+            s = fn(s)
+        float(s[0, 0])
+        times.append((time.perf_counter() - t0 - roundtrip) / CHAIN)
+    return max(min(times), 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# offset-window prototype: sublane cluster at bits [k, k+7), lane at [0,7)
+# ---------------------------------------------------------------------------
+
+
+def _offset_kernel(rank, apply_a):
+    def kernel(a_ref, ma_ref, mb_ref, o_ref):
+        x = a_ref[...]                   # (2, 1, 128, 1, 128)
+        xr, xi = x[0, :, :, 0], x[1, :, :, 0]    # (1, 128, 128)
+        xc0 = jnp.concatenate([xr, xi], axis=-1)
+        acc = None
+        for r in range(rank):
+            if apply_a:
+                xc = jax.lax.dot_general(
+                    xc0, ma_ref[r],
+                    dimension_numbers=(((2,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            else:
+                xc = xc0
+            yr, yi = xc[..., :DIM], xc[..., DIM:]
+            yc = jnp.concatenate([yr, yi], axis=1)       # (1, 256, 128)
+            out = jax.lax.dot_general(
+                mb_ref[r], yc,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                            # (256, 1, 128)
+            acc = out if acc is None else acc + out
+        acc = jnp.moveaxis(acc, 0, 1)                    # (1, 256, 128)
+        out = jnp.stack([acc[:, :DIM], acc[:, DIM:]], axis=0)
+        o_ref[...] = out.reshape(2, 1, DIM, 1, DIM)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "k", "apply_a"),
+         donate_argnums=0)
+def apply_offset_cluster(amps, mats_a, mats_b, *, num_qubits, k, apply_a=True):
+    """Cluster pass with lane cluster on bits [0,7) and sublane cluster on
+    bits [k, k+7), any 7 <= k <= n-7. No data relocation: the view
+    (2, hi, 128, mid, 128) exposes the window as the sublane axis."""
+    n = num_qubits
+    rank = mats_a.shape[0]
+    hi = 1 << (n - k - 7)
+    mid = 1 << (k - 7)
+    ma = jax.vmap(fused.lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
+    mb = jax.vmap(fused.sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
+    view = amps.reshape(2, hi, DIM, mid, DIM)
+    out = pl.pallas_call(
+        _offset_kernel(rank, apply_a),
+        grid=(hi, mid),
+        in_specs=[
+            pl.BlockSpec((2, 1, DIM, 1, DIM), lambda i, j: (0, i, 0, j, 0)),
+            pl.BlockSpec((rank, 2 * DIM, 2 * DIM), lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((rank, 2 * DIM, 2 * DIM), lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, 1, DIM, 1, DIM),
+                               lambda i, j: (0, i, 0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=jax.default_backend() != "tpu",
+    )(view, ma, mb)
+    return out.reshape(2, -1)
+
+
+def fresh_state():
+    return kernels.init_zero_state(1 << N, np.float32)
+
+
+def rand_cluster(rank, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((rank, 2, DIM, DIM)), jnp.float32)
+
+
+def main():
+    nbytes = 2 * (1 << N) * 4
+    print(f"N={N}: state {nbytes/2**30:.2f} GiB, pass traffic "
+          f"{2*nbytes/2**30:.2f} GiB (r+w)")
+
+    results = {}
+
+    def rec(name, t):
+        results[name] = t
+        print(f"{name:28s} {t*1e3:8.2f} ms {2*nbytes/t/1e9:8.1f} GB/s", flush=True)
+
+    for rank in (1, 2, 4):
+        a, b = rand_cluster(rank, 1), rand_cluster(rank, 2)
+        amps = fresh_state()
+        f = partial(fused.apply_cluster_stack, num_qubits=N)
+        t = timeit(lambda s: f(s, a, b), amps)
+        rec(f"cluster rank{rank}", t)
+
+    # swapfused m=3
+    for rank in (1, 4):
+        a, b = rand_cluster(rank, 3), rand_cluster(rank, 4)
+        amps = fresh_state()
+        t = timeit(
+            lambda s: fused.apply_swap_cluster_stack(
+                s, a, b, num_qubits=N, h=N - 3, b=7, m=3), amps)
+        rec(f"swapfused m=3 rank{rank}", t)
+
+    # standalone segswap m=7
+    amps = fresh_state()
+    t = timeit(lambda s: kernels.swap_bit_segments(
+        s, num_qubits=N, a=N - 7, b=7, m=7), amps)
+    rec("segswap m=7", t)
+
+    # offset window at several k
+    for k in (7, 13, N - 7):
+        for rank in (1, 2, 4):
+            a, b = rand_cluster(rank, 5), rand_cluster(rank, 6)
+            amps = fresh_state()
+            t = timeit(
+                lambda s: apply_offset_cluster(
+                    s, a, b, num_qubits=N, k=k), amps)
+            rec(f"offset k={k} rank{rank}", t)
+        # B-only variant (lane identity skipped)
+        a, b = rand_cluster(1, 7), rand_cluster(1, 8)
+        amps = fresh_state()
+        t = timeit(
+            lambda s: apply_offset_cluster(
+                s, a, b, num_qubits=N, k=k, apply_a=False), amps)
+        rec(f"offset k={k} B-only", t)
+
+
+
+
+if __name__ == "__main__":
+    main()

@@ -29,15 +29,10 @@ int main(int argc, char** argv) {
     QuESTEnv env = createQuESTEnv();
     Qureg q = createQureg(n, env);
 
-    /* one arbitrary fixed 1q unitary (values don't affect the rate) */
-    ComplexMatrix2 u;
-    double c = cos(0.4), s = sin(0.4);
-    u.real[0][0] = c;  u.real[0][1] = -s;
-    u.real[1][0] = s;  u.real[1][1] = c;
-    u.imag[0][0] = 0.1; u.imag[0][1] = 0.2;
-    u.imag[1][0] = 0.2; u.imag[1][1] = -0.1;
-    /* re-unitarise roughly: QuEST validates unitarity, so build exactly:
+    /* one arbitrary fixed 1q unitary (values don't affect the rate);
+       QuEST validates unitarity, so build exactly:
        U = [[a, -conj(b)], [b, conj(a)]], |a|^2+|b|^2 = 1 */
+    ComplexMatrix2 u;
     double ar = 0.6, ai = 0.3, br = 0.64807406984, bi = 0.35;
     double norm = sqrt(ar*ar + ai*ai + br*br + bi*bi);
     ar /= norm; ai /= norm; br /= norm; bi /= norm;

@@ -196,7 +196,7 @@ class TestNativeScheduler:
         rng = np.random.default_rng(200 + n)
         gates = _layered_circuit(rng, n, depth)
         ops_py = C.plan_circuit_py(gates, n)
-        ops_nat = C.plan_circuit(gates, n, use_native=True)
+        ops_nat = C.plan_circuit(gates, n, use_native=True, planner="paged")
         assert [o[0] for o in ops_py] == [o[0] for o in ops_nat]
         for a, b in zip(ops_py, ops_nat):
             if a[0] in ("permute", "segswap"):
@@ -217,7 +217,7 @@ class TestNativeScheduler:
         n = 15
         gates = _layered_circuit(rng, n, 2)
         amps0 = _rand_state(rng, n)
-        ops = C.plan_circuit(gates, n, use_native=True)
+        ops = C.plan_circuit(gates, n, use_native=True, planner="paged")
         out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
         ref = _apply_gatewise(amps0, gates, n)
         np.testing.assert_allclose(out, ref, atol=1e-5)
@@ -233,3 +233,137 @@ class TestNativeScheduler:
         assert native.plan_native([(16,)], 16) is None
         with pytest.raises(IndexError):
             C.plan_circuit(bad, 16, use_native=True)
+
+
+class TestWindowedScheduler:
+    """Offset-window planner (plan_circuit_windowed + apply_window_stack):
+    zero-relocation passes whose sublane cluster sits at an arbitrary
+    contiguous bit window [k, k+7)."""
+
+    def test_schmidt_rank(self):
+        rng = np.random.default_rng(21)
+        cnot = cplx.soa(CNOT).astype(np.float32)
+        terms = C.schmidt_terms_2q(cnot)
+        assert len(terms) == 2
+        cz = np.zeros((2, 4, 4), np.float32)
+        cz[0] = np.diag([1, 1, 1, -1])
+        assert len(C.schmidt_terms_2q(cz)) == 2
+        u1 = random_unitary(1, rng)
+        u2 = random_unitary(1, rng)
+        prod = cplx.soa(np.kron(u2, u1)).astype(np.float32)
+        assert len(C.schmidt_terms_2q(prod)) == 1
+        dense = cplx.soa(random_unitary(2, rng)).astype(np.float32)
+        assert len(C.schmidt_terms_2q(dense)) == 4
+
+    def test_schmidt_reconstruction(self):
+        rng = np.random.default_rng(22)
+        for u in [CNOT, random_unitary(2, rng)]:
+            soa = cplx.soa(u).astype(np.float64)
+            acc = np.zeros((4, 4), complex)
+            for lo, hi in C.schmidt_terms_2q(soa):
+                acc += np.kron(hi[0] + 1j * hi[1], lo[0] + 1j * lo[1])
+            np.testing.assert_allclose(acc, u, atol=1e-12)
+
+    @pytest.mark.parametrize("k", [7, 9, 13])
+    def test_window_stack_matches_gatewise(self, k):
+        n = 20
+        rng = np.random.default_rng(23 + k)
+        amps = _rand_state(rng, n)
+        ua = random_unitary(1, rng)
+        ub = random_unitary(1, rng)
+        ref = kernels.apply_matrix(
+            jnp.asarray(amps), jnp.asarray(cplx.soa(ua).astype(np.float32)),
+            num_qubits=n, targets=(3,))
+        ref = kernels.apply_matrix(
+            ref, jnp.asarray(cplx.soa(ub).astype(np.float32)),
+            num_qubits=n, targets=(k + 2,))
+        a = C.embed_in_cluster(cplx.soa(ua).astype(np.float32), (3,))
+        b = C.embed_in_cluster(cplx.soa(ub).astype(np.float32), (2,))
+        out = fused.apply_window_stack(
+            jnp.asarray(amps), a[None], b[None], num_qubits=n, k=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("n,depth", [(14, 3), (16, 2), (20, 2)])
+    def test_windowed_e2e(self, n, depth):
+        rng = np.random.default_rng(300 + n)
+        gates = _layered_circuit(rng, n, depth)
+        # sprinkle far cross gates + a window-internal dense 2q gate
+        gates.append(C.Gate((2, n - 1), cplx.soa(CNOT).astype(np.float32)))
+        if n >= 16:
+            gates.append(C.Gate(
+                (n - 6, n - 3),
+                cplx.soa(random_unitary(2, rng)).astype(np.float32)))
+        ops = C.plan_circuit_windowed(gates, n)
+        assert any(o[0] == "winfused" for o in ops)
+        amps0 = _rand_state(rng, n)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_windowed_beats_paged_pass_count(self):
+        rng = np.random.default_rng(31)
+        gates = _layered_circuit(rng, 20, 4)
+        win = C.stats(C.plan_circuit_windowed(gates, 20))
+        paged = C.stats(C.plan_circuit_py(gates, 20))
+        assert win["total_passes"] <= paged["total_passes"]
+        assert win["segswap"] == 0  # zero-relocation by construction
+
+    def test_rank_cap_respected(self):
+        rng = np.random.default_rng(32)
+        n = 15
+        # many cross CNOTs straddling lane x window in sequence
+        gates = []
+        for i in range(6):
+            gates.append(C.Gate((i % 7, 7 + (i % 7)),
+                                cplx.soa(CNOT).astype(np.float32)))
+        ops = C.plan_circuit_windowed(gates, n)
+        for op in ops:
+            if op[0] == "winfused":
+                assert op[2].shape[0] <= C.RANK_CAP
+        amps0 = _rand_state(rng, n)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestNativeWindowedScheduler:
+    """Parity of the C++ windowed planner (qts_plan_windowed) with the
+    Python reference implementation plan_circuit_windowed."""
+
+    @pytest.mark.parametrize("n,depth", [(14, 2), (16, 3), (20, 2)])
+    def test_plans_match_python(self, n, depth):
+        rng = np.random.default_rng(400 + n)
+        gates = _layered_circuit(rng, n, depth)
+        gates.append(C.Gate((2, n - 1), cplx.soa(CNOT).astype(np.float32)))
+        py = C.plan_circuit_windowed(gates, n)
+        structural = native.plan_native_windowed(
+            [g.targets for g in gates], n, C._gate_xranks(gates))
+        assert structural is not None, "native windowed planner unavailable"
+        nat = C.materialize_windowed_plan(structural, gates)
+        assert [o[0] for o in py] == [o[0] for o in nat]
+        for a, b in zip(py, nat):
+            if a[0] == "winfused":
+                assert a[1] == b[1]          # same window offset k
+                np.testing.assert_allclose(
+                    np.asarray(a[2]), np.asarray(b[2]), atol=1e-6)
+                np.testing.assert_allclose(
+                    np.asarray(a[3]), np.asarray(b[3]), atol=1e-6)
+                assert a[4:] == b[4:]        # same apply_a/apply_b flags
+            else:
+                assert tuple(a[1]) == tuple(b[1])
+
+    def test_native_windowed_e2e(self):
+        rng = np.random.default_rng(41)
+        n = 15
+        gates = _layered_circuit(rng, n, 2)
+        amps0 = _rand_state(rng, n)
+        ops = C.plan_circuit(gates, n, use_native=True, planner="windowed")
+        assert any(o[0] == "winfused" for o in ops)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_unknown_planner_rejected(self):
+        with pytest.raises(ValueError, match="unknown planner"):
+            C.plan_circuit([], 16, planner="window")
