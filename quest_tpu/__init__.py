@@ -31,6 +31,11 @@ from .qureg import Qureg, PauliHamil, DiagonalOp
 from .env import QuESTEnv
 from .qasm import QASMLogger
 from .api import *  # noqa: F401,F403
+from .fusion import (
+    gate_fusion as gateFusion,
+    start_gate_fusion as startGateFusion,
+    stop_gate_fusion as stopGateFusion,
+)
 from .api_ops import *  # noqa: F401,F403
 from .checkpoint import (
     saveQureg,

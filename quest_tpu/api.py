@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import env as _env
+from . import fusion as _fusion
 from . import rng as _rng
 from . import validation as V
 from .ops import calculations as C
@@ -430,11 +431,14 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
 
 def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     """Kernel on ket qubits; conjugated twin on bra qubits for rho
-    (QuEST.c:181-183).  ``matrix`` is host complex; stacked to SoA here."""
+    (QuEST.c:181-183).  ``matrix`` is host complex; stacked to SoA here.
+    Inside a gateFusion context the gate is buffered instead (fusion.py)."""
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
     stacked = CX.soa(matrix)
+    if _fusion.capture_unitary(qureg, stacked, targets, controls, control_states):
+        return
     qureg.amps = _dispatch_matrix(qureg, stacked, targets, controls, control_states)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
@@ -453,6 +457,8 @@ def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
     stacked = CX.soa(diag)
+    if _fusion.capture_diag(qureg, stacked, targets, controls, control_states):
+        return
     qureg.amps = K.apply_diagonal(
         qureg.amps, stacked, num_qubits=_sv_n(qureg), targets=targets,
         controls=controls, control_states=control_states,
@@ -679,6 +685,8 @@ def multiControlledMultiQubitNot(qureg, ctrls, targs) -> None:
 
 
 def _apply_not(qureg, targets, controls, control_states=()):
+    if _fusion.capture_not(qureg, targets, controls, control_states):
+        return
     qureg.amps = K.apply_multi_qubit_not(
         qureg.amps, num_qubits=_sv_n(qureg), targets=targets,
         controls=controls, control_states=control_states,

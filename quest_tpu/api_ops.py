@@ -634,6 +634,8 @@ def applyFullQFT(qureg: Qureg) -> None:
 
 
 def _apply_qft(qureg: Qureg, qubits) -> None:
+    if _qft_fused(qureg, qubits):
+        return
     n = len(qubits)
     for q in range(n - 1, -1, -1):
         hadamard(qureg, qubits[q])
@@ -660,6 +662,67 @@ def _apply_qft(qureg: Qureg, qubits) -> None:
         qureg.qasm_log.comment("here a controlled-phase ladder (QFT layer) was applied")
     for i in range(n // 2):
         swapGate(qureg, qubits[i], qubits[n - i - 1])
+
+
+_H_SOA = np.stack(
+    [np.array([[1.0, 1.0], [1.0, -1.0]]) / math.sqrt(2.0), np.zeros((2, 2))]
+)
+
+
+def _qft_fused(qureg: Qureg, qubits) -> bool:
+    """Fused QFT: the whole transform as ONE scheduled gate stream —
+    Hadamards + dense controlled-phase gates (concrete diagonals, so the
+    windowed planner folds the lane x window ones at operator-Schmidt
+    rank 2) + the final swap network collapsed into a single bit-reversal
+    axis-permutation pass.  The reference instead dispatches per layer
+    (agnostic_applyQFT, QuEST_common.c:836-898).  Falls back (returns
+    False) for sharded registers and sub-window sizes."""
+    from quest_tpu import circuit as CIRC
+    from quest_tpu.parallel import dist as PAR
+
+    nsv = _sv_n(qureg)
+    if nsv < CIRC.WINDOW:
+        return False
+    env = qureg.env
+    if env.mesh is not None and PAR.amp_axis_size(env.mesh) > 1:
+        return False
+
+    nt = len(qubits)
+    dt = np.dtype(qureg.dtype)
+    shifts = [0, _shift(qureg)] if qureg.is_density_matrix else [0]
+    gates = []
+    for conj, sh in zip((False, True), shifts):
+        sgn = -1.0 if conj else 1.0
+        h = _H_SOA.astype(dt)
+        for q in range(nt - 1, -1, -1):
+            gates.append(CIRC.Gate((qubits[q] + sh,), h))
+            for j in range(q):
+                theta = sgn * math.pi / (1 << (q - j))
+                cp = np.zeros((2, 4, 4), dt)
+                cp[0] = np.diag([1.0, 1.0, 1.0, math.cos(theta)])
+                cp[1, 3, 3] = math.sin(theta)
+                gates.append(CIRC.Gate((qubits[j] + sh, qubits[q] + sh), cp))
+    ops = CIRC.plan_circuit(gates, nsv)
+    # final bit-reversal of the targeted qubits (both halves for rho) as a
+    # single axis permutation instead of n/2 swap passes
+    perm = list(range(nsv))
+    for sh in shifts:
+        for i in range(nt // 2):
+            a, b = qubits[i] + sh, qubits[nt - 1 - i] + sh
+            perm[a], perm[b] = perm[b], perm[a]
+    if perm != list(range(nsv)):
+        ops.append(("permute", tuple(perm)))
+    qureg.amps = CIRC.execute_plan(qureg.amps, ops, nsv)
+
+    # QASM trail mirrors the layered path's record
+    for q in range(nt - 1, -1, -1):
+        qureg.qasm_log.gate("h", (), qubits[q])
+        if q:
+            qureg.qasm_log.comment(
+                "here a controlled-phase ladder (QFT layer) was applied")
+    for i in range(nt // 2):
+        qureg.qasm_log.gate("swap", (qubits[i],), qubits[nt - 1 - i])
+    return True
 
 
 # ---------------------------------------------------------------------------

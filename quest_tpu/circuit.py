@@ -70,21 +70,33 @@ class Gate:
     mat: object  # array-like; may be a traced jnp value
 
 
-def controlled_dense(mat_soa, num_controls: int):
+def controlled_dense(mat_soa, num_controls: int, control_states=()):
     """Embed a k-qubit SoA matrix as a (num_controls+k)-qubit controlled
-    matrix (controls = the high matrix bits, all conditioned on 1) so
-    controlled gates can enter the dense scheduling path."""
+    matrix (controls = the high matrix bits; control i is matrix bit k+i,
+    conditioned on ``control_states[i]``, default 1) so controlled gates can
+    enter the dense scheduling path.  Concrete numpy inputs stay numpy so
+    the scheduler can still Schmidt-decompose the result."""
     m = np.asarray(mat_soa) if not isinstance(mat_soa, jnp.ndarray) else mat_soa
     d = m.shape[-1]
-    full = d << num_controls
+    nc = int(num_controls)
+    full = d << nc
+    states = tuple(int(s) for s in control_states) or (1,) * nc
+    active = 0
+    for i, s in enumerate(states):
+        active |= (s & 1) << i
+    idx = np.arange(full)
+    ci, ti = idx // d, idx % d
+    same_c = ci[:, None] == ci[None, :]
+    gate_mask = same_c & (ci == active)[:, None]
+    eye_mask = same_c & (ci != active)[:, None] & (idx[:, None] == idx[None, :])
+    row = np.broadcast_to(ti[:, None], (full, full))
+    col = np.broadcast_to(ti[None, :], (full, full))
     if isinstance(m, np.ndarray):
-        out = np.zeros((2, full, full), dtype=m.dtype)
-        out[0, : full - d, : full - d] = np.eye(full - d)
-        out[:, full - d :, full - d :] = m
+        out = m[:, row, col] * gate_mask.astype(m.dtype)
+        out[0] += eye_mask.astype(m.dtype)
         return out
-    eye = np.zeros((2, full, full))
-    eye[0, : full - d, : full - d] = np.eye(full - d)
-    return jnp.asarray(eye, m.dtype).at[:, full - d :, full - d :].set(m)
+    out = m[:, row, col] * jnp.asarray(gate_mask, m.dtype)
+    return out.at[0].add(jnp.asarray(eye_mask, m.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -146,6 +158,7 @@ def _eye_cluster():
 _SCHMIDT_TOL = 1e-7
 
 
+_SCHMIDT_CACHE_MAX = 4096
 _schmidt_cache: dict = {}
 
 
@@ -169,6 +182,8 @@ def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
     hit = _schmidt_cache.get(key)
     if hit is not None:
         return hit
+    if len(_schmidt_cache) >= _SCHMIDT_CACHE_MAX:  # bound: drop oldest
+        _schmidt_cache.pop(next(iter(_schmidt_cache)))
     u = m[0] + 1j * m[1]
     # row index = 2*b1 + b0; regroup to T[(b1,b1'),(b0,b0')]
     t = u.reshape(2, 2, 2, 2).transpose(0, 2, 1, 3).reshape(4, 4)

@@ -1,0 +1,195 @@
+"""Gate fusion for the imperative API: batch gates, execute in few passes.
+
+The reference dispatches every API gate as one full sweep of the amplitude
+array (QuEST/src/QuEST.c:177-186 et al.) — there is nothing like this
+module in it.  On TPU a sweep is an HBM-bandwidth-bound pass, so the win
+is batching: inside a ``gateFusion(qureg)`` context, dense gates issued
+through the ordinary imperative API (hadamard, controlledNot, unitary,
+multiControlledUnitary, ...) are BUFFERED instead of executed, and drained
+through the circuit scheduler (circuit.plan_circuit — offset-window
+passes) the moment anything needs the amplitudes:
+
+    with qt.gateFusion(q):
+        for d in range(depth):
+            for t in range(n):
+                qt.hadamard(q, t)
+            for t in range(0, n - 1, 2):
+                qt.controlledNot(q, t, t + 1)
+    p = qt.calcProbOfOutcome(q, 0, 0)      # (any read would have drained)
+
+Semantics are IDENTICAL to the unfused path — validation and QASM
+recording still happen per call, in call order, and any operation that
+reads or writes the state (calculations, measurement, decoherence, phase
+functions, init) transparently drains the buffer first via the
+``Qureg.amps`` property — only the number of HBM passes changes.  Gates
+kept out of the buffer (too many qubits, explicit-distributed registers)
+drain it and execute eagerly, preserving order.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import circuit as C
+
+# largest dense gate (targets + controls) worth buffering; anything bigger
+# executes eagerly through the standard layout-safe kernels
+FUSION_MAX_GATE_QUBITS = 7
+
+
+class FusionBuffer:
+    __slots__ = ("gates",)
+
+    def __init__(self):
+        self.gates: List[C.Gate] = []
+
+
+def start_gate_fusion(qureg) -> None:
+    """Begin buffering dense gates on ``qureg`` (idempotent)."""
+    if getattr(qureg, "_fusion", None) is None:
+        qureg._fusion = FusionBuffer()
+
+
+def stop_gate_fusion(qureg) -> None:
+    """Drain any buffered gates and stop buffering."""
+    buf = getattr(qureg, "_fusion", None)
+    qureg._fusion = None
+    if buf is not None and buf.gates:
+        _run(qureg, buf.gates)
+
+
+def drain(qureg) -> None:
+    """Execute buffered gates now (called from the Qureg.amps property)."""
+    buf = getattr(qureg, "_fusion", None)
+    if buf is not None and buf.gates:
+        gates, buf.gates = buf.gates, []
+        _run(qureg, gates)
+
+
+def _run(qureg, gates) -> None:
+    # bypass the amps property (which would re-enter drain)
+    qureg._amps = C.apply_circuit(
+        qureg._amps, gates, qureg.num_qubits_in_state_vec
+    )
+
+
+def _capturable(qureg, num_bits: int) -> bool:
+    buf = getattr(qureg, "_fusion", None)
+    if buf is None:
+        return False
+    if num_bits > FUSION_MAX_GATE_QUBITS:
+        return False
+    env = qureg.env
+    if env.mesh is not None:
+        from .parallel import dist as PAR
+
+        if PAR.amp_axis_size(env.mesh) > 1:
+            # explicit-distributed path has its own relocalization planner
+            return False
+    return True
+
+
+def _conj(stacked):
+    if isinstance(stacked, np.ndarray):
+        return np.stack([stacked[0], -stacked[1]])
+    return jnp.stack([stacked[0], -stacked[1]])
+
+
+def capture_unitary(qureg, stacked, targets, controls=(),
+                    control_states=()) -> bool:
+    """Buffer a dense gate (with the density-matrix conjugate twin,
+    QuEST.c:181-183) if fusion is active and the gate qualifies; returns
+    False to tell the caller to execute eagerly (after draining, so order
+    is preserved)."""
+    nb = len(targets) + len(controls)
+    if not _capturable(qureg, nb):
+        drain(qureg)
+        return False
+    mat = stacked
+    if controls:
+        mat = C.controlled_dense(stacked, len(controls), control_states)
+    buf = qureg._fusion
+    buf.gates.append(C.Gate(tuple(targets) + tuple(controls), mat))
+    if qureg.is_density_matrix:
+        sh = qureg.num_qubits_represented
+        cmat = _conj(stacked)
+        if controls:
+            cmat = C.controlled_dense(cmat, len(controls), control_states)
+        buf.gates.append(
+            C.Gate(tuple(t + sh for t in targets)
+                   + tuple(c + sh for c in controls), cmat)
+        )
+    return True
+
+
+_X = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
+
+
+def capture_not(qureg, targets, controls=(), control_states=()) -> bool:
+    """Buffer a (multi-controlled) multi-qubit NOT: uncontrolled targets
+    become independent 1q X gates; controlled ones one dense gate."""
+    if not controls:
+        buf = getattr(qureg, "_fusion", None)
+        if buf is None:
+            return False
+        if not _capturable(qureg, 1):
+            drain(qureg)
+            return False
+        sh = qureg.num_qubits_represented
+        for t in targets:
+            buf.gates.append(C.Gate((t,), _X))
+            if qureg.is_density_matrix:
+                buf.gates.append(C.Gate((t + sh,), _X))
+        return True
+    # controlled: one dense gate, X^(x)nt (bit-reversal permutation matrix)
+    # under the controls.  Size-check BEFORE densifying — 2^nt x 2^nt
+    # would be catastrophic for a wide multiQubitNot outside the cap.
+    if not _capturable(qureg, len(targets) + len(controls)):
+        drain(qureg)
+        return False
+    nt = len(targets)
+    d = 1 << nt
+    xr = np.zeros((d, d))
+    for i in range(d):
+        xr[i, i ^ (d - 1)] = 1.0
+    mat = np.stack([xr, np.zeros((d, d))])
+    return capture_unitary(qureg, mat, targets, controls, control_states)
+
+
+def capture_diag(qureg, diag_stacked, targets, controls=(),
+                 control_states=()) -> bool:
+    """Buffer a diagonal gate as its dense matrix."""
+    nb = len(targets) + len(controls)
+    if not _capturable(qureg, nb):
+        drain(qureg)
+        return False
+    diag = diag_stacked
+    d = diag.shape[-1]
+    if isinstance(diag, np.ndarray):
+        mat = np.zeros((2, d, d), dtype=diag.dtype)
+        mat[0][np.diag_indices(d)] = diag[0]
+        mat[1][np.diag_indices(d)] = diag[1]
+    else:
+        mat = jnp.zeros((2, d, d), diag.dtype)
+        mat = mat.at[0, np.arange(d), np.arange(d)].set(diag[0])
+        mat = mat.at[1, np.arange(d), np.arange(d)].set(diag[1])
+    return capture_unitary(qureg, mat, targets, controls, control_states)
+
+
+@contextmanager
+def gate_fusion(qureg):
+    """Context manager: buffer dense imperative-API gates on ``qureg`` and
+    execute them through the fused circuit scheduler on exit (or the
+    moment any operation needs the amplitudes).  Nesting-safe: an inner
+    context reuses the outer buffer and leaves it active on exit."""
+    created = getattr(qureg, "_fusion", None) is None
+    start_gate_fusion(qureg)
+    try:
+        yield qureg
+    finally:
+        if created:
+            stop_gate_fusion(qureg)

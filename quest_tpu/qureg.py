@@ -46,6 +46,7 @@ class Qureg:
         self.dtype = precision.real_dtype()  # SoA channels are real arrays
         self.qasm_log = QASMLogger(num_qubits)
         self._amps: Optional[jax.Array] = None
+        self._fusion = None  # FusionBuffer while a gateFusion context is active
 
     # -- reference-parity metadata (QuEST.h:330-345) --
     @property
@@ -62,10 +63,19 @@ class Qureg:
 
     @property
     def amps(self) -> jax.Array:
+        if self._fusion is not None and self._fusion.gates:
+            from . import fusion
+
+            fusion.drain(self)
         return self._amps
 
     @amps.setter
     def amps(self, value: jax.Array):
+        if self._fusion is not None and self._fusion.gates:
+            # a pure overwrite makes pending gates unobservable (any RHS
+            # that depended on the old state already drained via the
+            # getter) — discard them instead of computing a dead result
+            self._fusion.gates.clear()
         self._amps = value
 
     def sharding(self):

@@ -1,0 +1,72 @@
+"""Per-pass timing of apply_window_stack on the real TPU at 26 qubits.
+
+Methodology: K chained passes inside ONE jitted program (single dispatch,
+one device->host fetch at the end), so relay round-trip latency is
+amortized to noise.  Prints ms/pass and effective HBM r+w bandwidth.
+"""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from quest_tpu.ops import fused, kernels
+
+N = int(os.environ.get("QT_MB_QUBITS", "26"))
+K = int(os.environ.get("QT_MB_CHAIN", "32"))
+REPS = 3
+DIM = fused.CLUSTER_DIM
+nbytes = 2 * (1 << N) * 4
+print(f"N={N}, chain={K}, pass traffic {2*nbytes/2**30:.2f} GiB r+w",
+      flush=True)
+
+
+def chain(k, rank, apply_a, apply_b):
+    @partial(jax.jit, donate_argnums=0)
+    def prog(amps, a, b):
+        for _ in range(K):
+            amps = fused.apply_window_stack(
+                amps, a, b, num_qubits=N, k=k,
+                apply_a=apply_a, apply_b=apply_b)
+        return amps[0, 0]
+
+    return prog
+
+
+def mats(rank, seed):
+    rng = np.random.default_rng(seed)
+    m = np.zeros((rank, 2, DIM, DIM))
+    for r in range(rank):
+        m[r, 0] = np.eye(DIM) + 0.01 * rng.standard_normal((DIM, DIM))
+        m[r, 1] = 0.01 * rng.standard_normal((DIM, DIM))
+    return jnp.asarray(m / max(1, rank), jnp.float32)
+
+
+def run(label, k, rank, apply_a=True, apply_b=True):
+    prog = chain(k, rank, apply_a, apply_b)
+    a, b = mats(rank, 1), mats(rank, 2)
+    s = kernels.init_zero_state(1 << N, np.float32)
+    out = prog(s, a, b)
+    float(out)  # compile + settle
+    best = 1e9
+    for _ in range(REPS):
+        s = kernels.init_zero_state(1 << N, np.float32)
+        float(np.asarray(s[0, 0]))
+        t0 = time.perf_counter()
+        out = prog(s, a, b)
+        float(out)
+        best = min(best, (time.perf_counter() - t0) / K)
+    print(f"{label}: {best*1e3:7.2f} ms/pass {2*nbytes/best/1e9:7.1f} GB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    for k in (7, 10, 13, 16, 19):
+        for rank in (1, 2, 4):
+            run(f"k={k:2d} rank={rank}", k, rank)
+        run(f"k={k:2d} B-only", k, 1, apply_a=False)
+    run("k= 7 A-only", 7, 1, apply_b=False)

@@ -1,0 +1,181 @@
+"""Gate-fusion context (quest_tpu/fusion.py): imperative API gates are
+buffered and drained through the circuit scheduler with IDENTICAL
+semantics to eager dispatch — only the number of HBM passes changes.
+(No reference counterpart: QuEST dispatches gate-at-a-time, QuEST.c.)
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import fusion
+
+N = 16  # >= 14 so the windowed scheduler engages
+
+
+@pytest.fixture
+def env():
+    # fusion captures only on single-device amplitude meshes (sharded
+    # registers use the explicit-distributed path); pin one device
+    return qt.createQuESTEnv(num_devices=1)
+
+
+def _layers(q, n, depth=3):
+    for d in range(depth):
+        for t in range(n):
+            qt.hadamard(q, t)
+        for t in range(d % 2, n - 1, 2):
+            qt.controlledNot(q, t, t + 1)
+    qt.controlledPhaseShift(q, 2, n - 1, 0.3)
+    qt.multiStateControlledUnitary(
+        q, [0, 9], [0, 1], 4, np.array([[0, 1], [1, 0]], complex))
+    qt.tGate(q, 5)
+    qt.rotateAroundAxis(q, 7, 0.4, qt.Vector(1.0, 1.0, 0.0))
+
+
+def _rel_err(a, b):
+    return np.abs(a - b).max() / np.abs(b).max()
+
+
+class TestEquivalence:
+    def test_statevector(self, env):
+        q0 = qt.createQureg(N, env)
+        qt.initPlusState(q0)
+        _layers(q0, N)
+        ref = np.asarray(q0.amps)
+
+        q1 = qt.createQureg(N, env)
+        qt.initPlusState(q1)
+        with qt.gateFusion(q1):
+            _layers(q1, N)
+        assert _rel_err(np.asarray(q1.amps), ref) < 1e-5
+
+    def test_density_matrix(self, env):
+        def prog(q):
+            qt.hadamard(q, 0)
+            qt.controlledNot(q, 0, 5)
+            qt.pauliY(q, 3)
+            qt.phaseShift(q, 6, 0.7)
+
+        q0 = qt.createDensityQureg(7, env)
+        qt.initPlusState(q0)
+        prog(q0)
+        qt.mixDepolarising(q0, 2, 0.05)
+        prog(q0)
+        ref = np.asarray(q0.amps)
+
+        q1 = qt.createDensityQureg(7, env)
+        qt.initPlusState(q1)
+        with qt.gateFusion(q1):
+            prog(q1)
+            qt.mixDepolarising(q1, 2, 0.05)  # implicit drain mid-context
+            prog(q1)
+        assert _rel_err(np.asarray(q1.amps), ref) < 1e-5
+
+
+class TestDrainTriggers:
+    def test_read_drains(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            assert len(q._fusion.gates) == 1
+            p = qt.calcProbOfOutcome(q, 0, 0)  # reads amps -> drain
+            assert len(q._fusion.gates) == 0
+            assert abs(p - 0.5) < 1e-6
+
+    def test_write_drains_in_order(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.pauliX(q, 0)
+            qt.initZeroState(q)  # overwrites; buffered X must not leak after
+            qt.hadamard(q, 1)
+        assert abs(qt.calcProbOfOutcome(q, 0, 1)) < 1e-6
+        assert abs(qt.calcProbOfOutcome(q, 1, 1) - 0.5) < 1e-6
+
+    def test_large_gate_falls_back_eagerly(self, env):
+        q = qt.createQureg(N, env)
+        qt.initPlusState(q)
+        u = np.eye(1 << 8, dtype=complex)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            qt.applyMatrixN(q, list(range(8)), u)  # 8 qubits > cap
+            # the big gate drained the buffer before executing eagerly
+            assert len(q._fusion.gates) == 0
+        assert abs(qt.calcTotalProb(q) - 1.0) < 1e-5
+
+    def test_context_exit_drains(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 3)
+            assert len(q._fusion.gates) == 1
+        assert q._fusion is None
+        assert abs(qt.calcProbOfOutcome(q, 3, 0) - 0.5) < 1e-6
+
+
+class TestSideChannels:
+    def test_qasm_recorded_in_call_order(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        qt.startRecordingQASM(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            qt.controlledNot(q, 0, 1)
+        qt.stopRecordingQASM(q)
+        text = str(q.qasm_log)
+        assert text.index("h q[0]") < text.index("cx q[0],q[1]")
+
+    def test_validation_still_eager(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            with pytest.raises(qt.QuESTError):
+                qt.hadamard(q, N)  # out of range
+
+    def test_measure_drains(self, env):
+        qt.seedQuEST(qt.createQuESTEnv(), [7])
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.pauliX(q, 4)
+            outcome = qt.measure(q, 4)
+        assert outcome == 1
+
+
+class TestReviewRegressions:
+    def test_nested_contexts_keep_outer_buffering(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            with qt.gateFusion(q):  # inner context reuses the outer buffer
+                qt.hadamard(q, 1)
+            assert q._fusion is not None  # outer still active
+            qt.hadamard(q, 2)
+            assert len(q._fusion.gates) == 3
+        assert q._fusion is None
+        for t in (0, 1, 2):
+            assert abs(qt.calcProbOfOutcome(q, t, 0) - 0.5) < 1e-6
+
+    def test_wide_controlled_not_stays_cheap(self, env):
+        # 20 targets under one control must NOT densify 2^20 x 2^20
+        n = 22
+        q = qt.createQureg(n, env)
+        qt.initZeroState(q)
+        qt.pauliX(q, n - 1)
+        with qt.gateFusion(q):
+            qt.multiControlledMultiQubitNot(q, [n - 1], list(range(20)))
+        for t in range(20):
+            assert abs(qt.calcProbOfOutcome(q, t, 1) - 1.0) < 1e-6
+
+    def test_overwrite_discards_buffer_cheaply(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            qt.initClassicalState(q, 5)  # overwrite: buffer dropped unexecuted
+            assert len(q._fusion.gates) == 0
+        assert abs(qt.calcProbOfOutcome(q, 0, 1) - 1.0) < 1e-6
+        assert abs(qt.calcProbOfOutcome(q, 2, 1) - 1.0) < 1e-6

@@ -314,3 +314,51 @@ def test_operator_validation(env, rng):
         qt.applyTrotterCircuit(q, hamil, 0.1, 3, 1)
     with pytest.raises(qt.QuESTError, match="encoding"):
         qt.applyPhaseFunc(q, [0, 1], 5, [1.0], [1.0])
+
+
+# ---------------------------------------------------------------------------
+# Fused QFT (windowed-scheduler gate stream; single-device registers >= 14
+# state-vector qubits take this path, sharded ones the layered path)
+# ---------------------------------------------------------------------------
+
+
+def _norm_psi(rng, n):
+    v = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    return v / np.linalg.norm(v)
+
+
+@pytest.mark.parametrize("qubits", [None, [0, 3, 9, 13, 7], [13, 2, 5]])
+def test_fused_qft_matches_layered(rng, qubits):
+    env1 = qt.createQuESTEnv(num_devices=1)   # fused path
+    env8 = qt.createQuESTEnv()                # sharded -> layered fallback
+    n = 14
+    vec = _norm_psi(rng, n)
+
+    q1 = qt.createQureg(n, env1)
+    qt.initStateFromAmps(q1, vec.real.copy(), vec.imag.copy())
+    q8 = qt.createQureg(n, env8)
+    qt.initStateFromAmps(q8, vec.real.copy(), vec.imag.copy())
+    if qubits is None:
+        qt.applyFullQFT(q1)
+        qt.applyFullQFT(q8)
+    else:
+        qt.applyQFT(q1, qubits)
+        qt.applyQFT(q8, qubits)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(q1), oracle.state_from_qureg(q8), atol=1e-10
+    )
+
+
+def test_fused_qft_density_matches_layered(rng):
+    env1 = qt.createQuESTEnv(num_devices=1)
+    env8 = qt.createQuESTEnv()
+    n = 7  # state vector = 14 qubits
+    r1 = qt.createDensityQureg(n, env1)
+    qt.initDebugState(r1)
+    r8 = qt.createDensityQureg(n, env8)
+    qt.initDebugState(r8)
+    qt.applyFullQFT(r1)
+    qt.applyFullQFT(r8)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(r1), oracle.state_from_qureg(r8), atol=1e-9
+    )
