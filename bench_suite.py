@@ -67,10 +67,21 @@ def config1():
             qt.controlledRotateX(q, t - 1, t, 0.3)
         return qt.calcProbOfOutcome(q, n - 1, 0)
 
+    def run_fused():
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            for t in range(1, n):
+                qt.controlledRotateX(q, t - 1, t, 0.3)
+        return qt.calcProbOfOutcome(q, n - 1, 0)
+
     seconds, prob = _time_best(run)
+    fused_seconds, fused_prob = _time_best(run_fused)
     gates = n  # 1 H + (n-1) controlled rotations
     _emit(1, "12q API chain gate rate", gates * (1 << n) / seconds,
-          "amp_updates_per_sec", seconds, {"prob": prob})
+          "amp_updates_per_sec", seconds,
+          {"prob": prob, "gatefusion_seconds": fused_seconds,
+           "gatefusion_prob": fused_prob})
 
 
 def config2():

@@ -707,8 +707,17 @@ def controlledPauliY(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
     qureg.qasm_log.gate("y", (controlQubit,), targetQubit)
 
 
+_SWAP_SOA = np.stack([
+    np.array([[1.0, 0, 0, 0], [0, 0, 1, 0], [0, 1, 0, 0], [0, 0, 0, 1]]),
+    np.zeros((4, 4)),
+])
+
+
 def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
     V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
+    if _fusion.capture_unitary(qureg, _SWAP_SOA, (qubit1, qubit2)):
+        qureg.qasm_log.gate("swap", (qubit1,), qubit2)
+        return
     qureg.amps = K.swap_qubit_amps(qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
     if qureg.is_density_matrix:
         sh = _shift(qureg)

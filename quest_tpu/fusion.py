@@ -29,12 +29,15 @@ drain it and execute eagerly, preserving order.
 from __future__ import annotations
 
 from contextlib import contextmanager
+from functools import lru_cache, partial
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import circuit as C
+from .ops import cplx as _cplx
 
 # largest dense gate (targets + controls) worth buffering; anything bigger
 # executes eagerly through the standard layout-safe kernels
@@ -71,10 +74,60 @@ def drain(qureg) -> None:
 
 
 def _run(qureg, gates) -> None:
+    """Plan with the CONCRETE gate matrices (so controlled gates Schmidt-
+    decompose to their true rank), then execute the whole plan as ONE
+    jitted dispatch — the pass arrays enter as traced arguments and the
+    compiled program is cached on the plan skeleton, so repeated drains of
+    the same circuit shape (e.g. angle sweeps) never recompile and cost a
+    single host->device round-trip."""
+    n = qureg.num_qubits_in_state_vec
+    ops = C.plan_circuit(gates, n)
+    skeleton = []
+    arrays = []
+    for op in ops:
+        if op[0] == "winfused":
+            skeleton.append(("winfused", op[1], tuple(np.shape(op[2])),
+                             op[4], op[5]))
+            arrays.extend([op[2], op[3]])
+        elif op[0] == "apply":
+            skeleton.append(("apply", tuple(op[1]), tuple(np.shape(op[2]))))
+            arrays.append(op[2])
+        elif op[0] == "fused":
+            skeleton.append(("fused", tuple(np.shape(op[1]))))
+            arrays.extend([op[1], op[2]])
+        elif op[0] == "swapfused":
+            skeleton.append(("swapfused", op[1], op[2], op[3],
+                             tuple(np.shape(op[4]))))
+            arrays.extend([op[4], op[5]])
+        else:  # segswap / permute: fully static
+            skeleton.append(tuple(op))
+    runner = _plan_runner(n, tuple(skeleton))
     # bypass the amps property (which would re-enter drain)
-    qureg._amps = C.apply_circuit(
-        qureg._amps, gates, qureg.num_qubits_in_state_vec
-    )
+    qureg._amps = runner(qureg._amps, arrays)
+
+
+@lru_cache(maxsize=256)
+def _plan_runner(n: int, skeleton: tuple):
+    @partial(jax.jit, donate_argnums=0)
+    def run(amps, arrays):
+        it = iter(arrays)
+        ops = []
+        for sk in skeleton:
+            if sk[0] == "winfused":
+                a, b = next(it), next(it)
+                ops.append(("winfused", sk[1], a, b, sk[3], sk[4]))
+            elif sk[0] == "apply":
+                ops.append(("apply", sk[1], next(it)))
+            elif sk[0] == "fused":
+                ops.append(("fused", next(it), next(it)))
+            elif sk[0] == "swapfused":
+                a, b = next(it), next(it)
+                ops.append(("swapfused", sk[1], sk[2], sk[3], a, b))
+            else:
+                ops.append(sk)
+        return C.execute_plan(amps, ops, n)
+
+    return run
 
 
 def _capturable(qureg, num_bits: int) -> bool:
@@ -91,12 +144,6 @@ def _capturable(qureg, num_bits: int) -> bool:
             # explicit-distributed path has its own relocalization planner
             return False
     return True
-
-
-def _conj(stacked):
-    if isinstance(stacked, np.ndarray):
-        return np.stack([stacked[0], -stacked[1]])
-    return jnp.stack([stacked[0], -stacked[1]])
 
 
 def capture_unitary(qureg, stacked, targets, controls=(),
@@ -116,7 +163,7 @@ def capture_unitary(qureg, stacked, targets, controls=(),
     buf.gates.append(C.Gate(tuple(targets) + tuple(controls), mat))
     if qureg.is_density_matrix:
         sh = qureg.num_qubits_represented
-        cmat = _conj(stacked)
+        cmat = _cplx.conj(stacked)
         if controls:
             cmat = C.controlled_dense(cmat, len(controls), control_states)
         buf.gates.append(
@@ -145,8 +192,8 @@ def capture_not(qureg, targets, controls=(), control_states=()) -> bool:
             if qureg.is_density_matrix:
                 buf.gates.append(C.Gate((t + sh,), _X))
         return True
-    # controlled: one dense gate, X^(x)nt (bit-reversal permutation matrix)
-    # under the controls.  Size-check BEFORE densifying — 2^nt x 2^nt
+    # controlled: one dense gate, X^(x)nt (the bit-COMPLEMENT permutation
+    # i -> i ^ (2^nt - 1)) under the controls.  Size-check BEFORE densifying — 2^nt x 2^nt
     # would be catastrophic for a wide multiQubitNot outside the cap.
     if not _capturable(qureg, len(targets) + len(controls)):
         drain(qureg)

@@ -96,10 +96,22 @@ def _random_unitary_host(rng):
     return q * (np.diag(r) / np.abs(np.diag(r)))
 
 
-def qft_circuit(amps, num_qubits: int):
-    """Full QFT as one traceable program (fused controlled-phase ladders via
-    the SCALED_PRODUCT phase kernel — reference agnostic_applyQFT strategy,
-    QuEST_common.c:836-898)."""
+def qft_circuit(amps, num_qubits: int, layered: bool = False):
+    """Full QFT as one traceable program.
+
+    Default: circuit.fused_qft — one fused elementwise ladder pass per
+    high layer (Hadamard + whole controlled-phase ladder), the low layers
+    folded by the windowed scheduler, and the swap network collapsed to
+    ONE bit-reversal axis permutation.
+
+    ``layered=True`` (or n below the window size) uses the reference's
+    per-layer strategy instead: H + SCALED_PRODUCT phase-ladder sweeps +
+    pairwise swaps (agnostic_applyQFT, QuEST_common.c:836-898)."""
+    n = num_qubits
+    if not layered and n >= 14:
+        from quest_tpu import circuit as CIRC
+
+        return CIRC.fused_qft(amps, n, 0, n)
     empty_i = np.zeros((0, 2), np.int64)
     empty_p = np.zeros((0,), np.float64)
     for q in range(num_qubits - 1, -1, -1):

@@ -56,6 +56,8 @@ def cmul_s(a, b):
 
 
 def conj(s):
+    if isinstance(s, np.ndarray):
+        return np.stack([s[0], -s[1]])
     return jnp.stack([s[0], -s[1]])
 
 

@@ -1,0 +1,23 @@
+import os, sys, time
+from functools import partial
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, numpy as np
+from quest_tpu.ops import kernels
+N = 26
+nbytes = 2 * (1 << N) * 4
+
+def t1(label, fn):
+    s = kernels.init_zero_state(1 << N, np.float32)
+    s = fn(s); float(np.asarray(s[0, 0]))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = fn(s); float(np.asarray(s[0, 0]))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label}: {best*1e3:7.2f} ms {2*nbytes/best/1e9:7.1f} GB/s", flush=True)
+
+perm = tuple(N - 1 - i for i in range(N))
+t1("bit-reversal permute", lambda s: kernels.permute_qubits(s, num_qubits=N, perm=perm))
+for t in (25, 19, 13, 7):
+    t1(f"ladder t={t:2d}", lambda s, _t=t: kernels.apply_qft_ladder(s, num_qubits=N, target=_t))
+t1("swap(0,25)", lambda s: kernels.swap_qubit_amps(s, num_qubits=N, qb1=0, qb2=25))

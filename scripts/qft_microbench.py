@@ -1,0 +1,49 @@
+"""Time the pieces of the fused QFT at 26q: ladder passes vs the final
+bit-reversal permute. One-jit chain methodology."""
+import os, sys, time
+from functools import partial
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, numpy as np, jax.numpy as jnp
+from quest_tpu.ops import kernels
+
+N = 26
+K = 8
+nbytes = 2 * (1 << N) * 4
+
+def timeit(label, prog, *args):
+    s = kernels.init_zero_state(1 << N, np.float32)
+    out = prog(s, *args); float(out)
+    best = 1e9
+    for _ in range(3):
+        s = kernels.init_zero_state(1 << N, np.float32)
+        float(np.asarray(s[0, 0]))
+        t0 = time.perf_counter()
+        out = prog(s, *args); float(out)
+        best = min(best, (time.perf_counter() - t0) / K)
+    print(f"{label}: {best*1e3:7.2f} ms/pass {2*nbytes/best/1e9:7.1f} GB/s",
+          flush=True)
+
+for t in (25, 19, 13, 7):
+    @partial(jax.jit, donate_argnums=0)
+    def lad(s, _t=t):
+        for _ in range(K):
+            s = kernels.apply_qft_ladder(s, num_qubits=N, target=_t)
+        return s[0, 0]
+    timeit(f"ladder t={t:2d}", lad)
+
+perm = tuple(N - 1 - i for i in range(N))
+@partial(jax.jit, donate_argnums=0)
+def rev(s):
+    for _ in range(K):
+        s = kernels.permute_qubits(s, num_qubits=N, perm=perm)
+    return s[0, 0]
+timeit("bit-reversal permute", rev)
+
+# swap-based alternative: 13 pairwise bit swaps
+@partial(jax.jit, donate_argnums=0)
+def swaps(s):
+    for _ in range(K):
+        for i in range(N // 2):
+            s = kernels.swap_qubit_amps(s, num_qubits=N, qb1=i, qb2=N-1-i)
+    return s[0, 0]
+timeit("13 pairwise swaps  ", swaps)

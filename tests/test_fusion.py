@@ -179,3 +179,23 @@ class TestReviewRegressions:
             assert len(q._fusion.gates) == 0
         assert abs(qt.calcProbOfOutcome(q, 0, 1) - 1.0) < 1e-6
         assert abs(qt.calcProbOfOutcome(q, 2, 1) - 1.0) < 1e-6
+
+
+class TestSwapCapture:
+    def test_swap_gate_buffers(self, env):
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        qt.pauliX(q, 2)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 0)
+            qt.swapGate(q, 2, 9)          # buffered, not a drain
+            assert len(q._fusion.gates) == 2
+        assert abs(qt.calcProbOfOutcome(q, 9, 1) - 1.0) < 1e-6
+        assert abs(qt.calcProbOfOutcome(q, 2, 1)) < 1e-6
+
+    def test_swap_gate_density(self, env):
+        r = qt.createDensityQureg(7, env)
+        qt.initClassicalState(r, 1)
+        with qt.gateFusion(r):
+            qt.swapGate(r, 0, 6)
+        assert abs(qt.calcProbOfOutcome(r, 6, 1) - 1.0) < 1e-6

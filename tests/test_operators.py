@@ -362,3 +362,22 @@ def test_fused_qft_density_matches_layered(rng):
     np.testing.assert_allclose(
         oracle.state_from_qureg(r1), oracle.state_from_qureg(r8), atol=1e-9
     )
+
+
+def test_fused_qft_contiguous_high_subset(rng):
+    """Contiguous run starting >= 7 takes the fused sub-run branch
+    (B-side-only group reversal at k = min(o, n-7))."""
+    env1 = qt.createQuESTEnv(num_devices=1)
+    env8 = qt.createQuESTEnv()
+    n = 16
+    vec = _norm_psi(rng, n)
+    q1 = qt.createQureg(n, env1)
+    qt.initStateFromAmps(q1, vec.real.copy(), vec.imag.copy())
+    q8 = qt.createQureg(n, env8)
+    qt.initStateFromAmps(q8, vec.real.copy(), vec.imag.copy())
+    qubits = list(range(7, 16))   # contiguous, start=7, count=9
+    qt.applyQFT(q1, qubits)
+    qt.applyQFT(q8, qubits)
+    np.testing.assert_allclose(
+        oracle.state_from_qureg(q1), oracle.state_from_qureg(q8), atol=1e-10
+    )
