@@ -222,6 +222,24 @@ def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
 # ---------------------------------------------------------------------------
 
 
+def _stack_sides(As, Bs):
+    """Stack per-rank side matrices (None = identity) into (R, 2, 128, 128)
+    arrays; stays numpy when every term is concrete (plan materialization
+    outside jit must not issue eager device ops)."""
+    eye = _eye_cluster()
+    if all(x is None or isinstance(x, np.ndarray) for x in As + Bs):
+        dts = [x.dtype for x in As + Bs if x is not None]
+        dt = dts[0] if dts else np.float64
+        a = np.stack([x if x is not None else eye.astype(dt) for x in As])
+        b = np.stack([x if x is not None else eye.astype(dt) for x in Bs])
+        return a, b
+    a = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
+                   for x in As])
+    b = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
+                   for x in Bs])
+    return a, b
+
+
 _CROSS_RANK = 4  # rank of the |a><b| (x) U_ab decomposition of a 2q gate
 
 
@@ -274,21 +292,7 @@ class _FoldAcc:
         self.count += 1
 
     def stacks(self):
-        eye = _eye_cluster()
-        if all(x is None or isinstance(x, np.ndarray)
-               for x in self.As + self.Bs):
-            dts = [x.dtype for x in self.As + self.Bs if x is not None]
-            dt = dts[0] if dts else np.float64
-            a = np.stack([x if x is not None else eye.astype(dt)
-                          for x in self.As])
-            b = np.stack([x if x is not None else eye.astype(dt)
-                          for x in self.Bs])
-            return a, b
-        a = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
-                       for x in self.As])
-        b = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
-                       for x in self.Bs])
-        return a, b
+        return _stack_sides(self.As, self.Bs)
 
     def reset(self):
         self.As, self.Bs = [None], [None]
@@ -361,21 +365,7 @@ class _WinAcc:
         self.count += 1
 
     def stacks(self):
-        eye = _eye_cluster()
-        if all(x is None or isinstance(x, np.ndarray)
-               for x in self.As + self.Bs):
-            dts = [x.dtype for x in self.As + self.Bs if x is not None]
-            dt = dts[0] if dts else np.float64
-            a = np.stack([x if x is not None else eye.astype(dt)
-                          for x in self.As])
-            b = np.stack([x if x is not None else eye.astype(dt)
-                          for x in self.Bs])
-            return a, b
-        a = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
-                       for x in self.As])
-        b = jnp.stack([jnp.asarray(x) if x is not None else jnp.asarray(eye)
-                       for x in self.Bs])
-        return a, b
+        return _stack_sides(self.As, self.Bs)
 
 
 class _Plan:
@@ -1098,4 +1088,56 @@ def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
             off += sz
     if perm != list(range(n)):
         ops.append(("permute", tuple(perm)))
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# Plan (de)composition: static skeleton + array operands
+# ---------------------------------------------------------------------------
+
+
+def split_plan(ops: Sequence[tuple]):
+    """(hashable skeleton, array list): separates an executable plan into
+    its static structure and its array operands so callers can jit (and
+    cache) an executor keyed on the skeleton while the matrices stay
+    traced arguments (fusion drains, sharded executors)."""
+    skeleton: List[tuple] = []
+    arrays: List[object] = []
+    for op in ops:
+        if op[0] == "winfused":
+            skeleton.append(("winfused", op[1], tuple(np.shape(op[2])),
+                             op[4], op[5]))
+            arrays.extend([op[2], op[3]])
+        elif op[0] == "apply":
+            skeleton.append(("apply", tuple(op[1]), tuple(np.shape(op[2]))))
+            arrays.append(op[2])
+        elif op[0] == "fused":
+            skeleton.append(("fused", tuple(np.shape(op[1]))))
+            arrays.extend([op[1], op[2]])
+        elif op[0] == "swapfused":
+            skeleton.append(("swapfused", op[1], op[2], op[3],
+                             tuple(np.shape(op[4]))))
+            arrays.extend([op[4], op[5]])
+        else:  # segswap / permute: fully static
+            skeleton.append(tuple(op))
+    return tuple(skeleton), arrays
+
+
+def rebuild_plan(skeleton: Sequence[tuple], arrays: Sequence) -> List[tuple]:
+    """Inverse of split_plan given the (possibly traced) array operands."""
+    it = iter(arrays)
+    ops: List[tuple] = []
+    for sk in skeleton:
+        if sk[0] == "winfused":
+            a, b = next(it), next(it)
+            ops.append(("winfused", sk[1], a, b, sk[3], sk[4]))
+        elif sk[0] == "apply":
+            ops.append(("apply", sk[1], next(it)))
+        elif sk[0] == "fused":
+            ops.append(("fused", next(it), next(it)))
+        elif sk[0] == "swapfused":
+            a, b = next(it), next(it)
+            ops.append(("swapfused", sk[1], sk[2], sk[3], a, b))
+        else:
+            ops.append(sk)
     return ops

@@ -81,68 +81,74 @@ def _run(qureg, gates) -> None:
     the same circuit shape (e.g. angle sweeps) never recompile and cost a
     single host->device round-trip."""
     n = qureg.num_qubits_in_state_vec
-    ops = C.plan_circuit(gates, n)
-    skeleton = []
-    arrays = []
-    for op in ops:
-        if op[0] == "winfused":
-            skeleton.append(("winfused", op[1], tuple(np.shape(op[2])),
-                             op[4], op[5]))
-            arrays.extend([op[2], op[3]])
-        elif op[0] == "apply":
-            skeleton.append(("apply", tuple(op[1]), tuple(np.shape(op[2]))))
-            arrays.append(op[2])
-        elif op[0] == "fused":
-            skeleton.append(("fused", tuple(np.shape(op[1]))))
-            arrays.extend([op[1], op[2]])
-        elif op[0] == "swapfused":
-            skeleton.append(("swapfused", op[1], op[2], op[3],
-                             tuple(np.shape(op[4]))))
-            arrays.extend([op[4], op[5]])
-        else:  # segswap / permute: fully static
-            skeleton.append(tuple(op))
-    runner = _plan_runner(n, tuple(skeleton))
+    nsh = _shard_bits(qureg)
+    nloc = n - nsh
+    ops = C.plan_circuit(gates, nloc)
+    skeleton, arrays = C.split_plan(ops)
+    runner = _plan_runner(nloc, skeleton,
+                          qureg.env.mesh if nsh else None)
     # bypass the amps property (which would re-enter drain)
     qureg._amps = runner(qureg._amps, arrays)
 
 
 @lru_cache(maxsize=256)
-def _plan_runner(n: int, skeleton: tuple):
+def _plan_runner(nloc: int, skeleton: tuple, mesh):
+    """Jitted whole-plan executor.  For a sharded register the plan (all
+    gates shard-local by capture policy) runs inside ONE shard_map over
+    the amplitude mesh — the multi-chip analogue of the drain."""
+
     @partial(jax.jit, donate_argnums=0)
     def run(amps, arrays):
-        it = iter(arrays)
-        ops = []
-        for sk in skeleton:
-            if sk[0] == "winfused":
-                a, b = next(it), next(it)
-                ops.append(("winfused", sk[1], a, b, sk[3], sk[4]))
-            elif sk[0] == "apply":
-                ops.append(("apply", sk[1], next(it)))
-            elif sk[0] == "fused":
-                ops.append(("fused", next(it), next(it)))
-            elif sk[0] == "swapfused":
-                a, b = next(it), next(it)
-                ops.append(("swapfused", sk[1], sk[2], sk[3], a, b))
-            else:
-                ops.append(sk)
-        return C.execute_plan(amps, ops, n)
+        if mesh is None:
+            return C.execute_plan(amps, C.rebuild_plan(skeleton, arrays),
+                                  nloc)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from .env import AMP_AXIS
+
+        def kernel(local, *arrs):
+            return C.execute_plan(local, C.rebuild_plan(skeleton, arrs),
+                                  nloc)
+
+        return shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P(None, AMP_AXIS),) + (P(),) * len(arrays),
+            out_specs=P(None, AMP_AXIS),
+            check_vma=False,  # pallas_call inside shard_map has no vma info
+        )(amps, *arrays)
 
     return run
 
 
-def _capturable(qureg, num_bits: int) -> bool:
+def _shard_bits(qureg) -> int:
+    """Number of leading qubits held as mesh coordinates (0 when the
+    register is single-device or replicated)."""
+    env = qureg.env
+    if env.mesh is None:
+        return 0
+    from .parallel import dist as PAR
+
+    nd = PAR.amp_axis_size(env.mesh)
+    if nd <= 1 or qureg.num_amps_total < env.num_devices:
+        return 0
+    return PAR.num_shard_bits(env.mesh)
+
+
+def _capturable(qureg, bits) -> bool:
+    """Can a dense gate on qubit positions ``bits`` be buffered?  Size-
+    capped, and on a sharded register every bit must be shard-local (the
+    drain then runs the whole plan inside one shard_map; gates touching
+    mesh-coordinate bits fall back to the explicit-distributed path)."""
     buf = getattr(qureg, "_fusion", None)
     if buf is None:
         return False
-    if num_bits > FUSION_MAX_GATE_QUBITS:
+    bits = tuple(bits)
+    if len(bits) > FUSION_MAX_GATE_QUBITS:
         return False
-    env = qureg.env
-    if env.mesh is not None:
-        from .parallel import dist as PAR
-
-        if PAR.amp_axis_size(env.mesh) > 1:
-            # explicit-distributed path has its own relocalization planner
-            return False
+    nsh = _shard_bits(qureg)
+    if nsh and max(bits) >= qureg.num_qubits_in_state_vec - nsh:
+        return False
     return True
 
 
@@ -152,8 +158,12 @@ def capture_unitary(qureg, stacked, targets, controls=(),
     QuEST.c:181-183) if fusion is active and the gate qualifies; returns
     False to tell the caller to execute eagerly (after draining, so order
     is preserved)."""
-    nb = len(targets) + len(controls)
-    if not _capturable(qureg, nb):
+    base_bits = tuple(targets) + tuple(controls)
+    ok = _capturable(qureg, base_bits)
+    if ok and qureg.is_density_matrix:
+        sh = qureg.num_qubits_represented
+        ok = _capturable(qureg, tuple(b + sh for b in base_bits))
+    if not ok:
         drain(qureg)
         return False
     mat = stacked
@@ -183,19 +193,23 @@ def capture_not(qureg, targets, controls=(), control_states=()) -> bool:
         buf = getattr(qureg, "_fusion", None)
         if buf is None:
             return False
-        if not _capturable(qureg, 1):
+        sh = qureg.num_qubits_represented
+        bits = list(targets)
+        if qureg.is_density_matrix:
+            bits += [t + sh for t in targets]
+        if not all(_capturable(qureg, (b,)) for b in bits):
             drain(qureg)
             return False
-        sh = qureg.num_qubits_represented
         for t in targets:
             buf.gates.append(C.Gate((t,), _X))
             if qureg.is_density_matrix:
                 buf.gates.append(C.Gate((t + sh,), _X))
         return True
     # controlled: one dense gate, X^(x)nt (the bit-COMPLEMENT permutation
-    # i -> i ^ (2^nt - 1)) under the controls.  Size-check BEFORE densifying — 2^nt x 2^nt
-    # would be catastrophic for a wide multiQubitNot outside the cap.
-    if not _capturable(qureg, len(targets) + len(controls)):
+    # i -> i ^ (2^nt - 1)) under the controls.  Size-check BEFORE
+    # densifying — 2^nt x 2^nt would be catastrophic for a wide
+    # multiQubitNot outside the cap.
+    if not _capturable(qureg, tuple(targets) + tuple(controls)):
         drain(qureg)
         return False
     nt = len(targets)
@@ -210,8 +224,7 @@ def capture_not(qureg, targets, controls=(), control_states=()) -> bool:
 def capture_diag(qureg, diag_stacked, targets, controls=(),
                  control_states=()) -> bool:
     """Buffer a diagonal gate as its dense matrix."""
-    nb = len(targets) + len(controls)
-    if not _capturable(qureg, nb):
+    if not _capturable(qureg, tuple(targets) + tuple(controls)):
         drain(qureg)
         return False
     diag = diag_stacked

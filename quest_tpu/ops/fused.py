@@ -320,8 +320,9 @@ def apply_window_stack(
     mid = 1 << (k - LANE_QUBITS)
     # batch hi first (contiguous super-blocks), then mid, to ~block_amps;
     # scale down with rank — the unrolled rank loop multiplies the scoped
-    # VMEM for temporaries (observed 18.4M > the 16M limit at rank 4, R 8)
-    block_amps = max(BLOCK_AMPS, block_amps // rank)
+    # VMEM for temporaries (observed 18.4M > the 16M limit at rank 4 with
+    # 8 blocks; 16/rank blocks keeps ~9M with better matmul batching)
+    block_amps = max(BLOCK_AMPS, 2 * block_amps // rank)
     R = min(hi, max(1, block_amps // BLOCK_AMPS))
     while hi % R:
         R //= 2

@@ -199,3 +199,47 @@ class TestSwapCapture:
         with qt.gateFusion(r):
             qt.swapGate(r, 0, 6)
         assert abs(qt.calcProbOfOutcome(r, 6, 1) - 1.0) < 1e-6
+
+
+class TestShardedFusion:
+    """Fusion on SHARDED registers: local-bit gates buffer and drain as
+    one shard_map program over the amplitude mesh; gates touching
+    mesh-coordinate bits drain and run the explicit-distributed path."""
+
+    def test_sharded_drain_matches_eager(self):
+        env8 = qt.createQuESTEnv()  # 8 virtual devices -> 3 shard bits
+        n = 17                      # nloc = 14: full window space local
+
+        def prog(q):
+            for t in range(14):
+                qt.hadamard(q, t)
+            for t in range(0, 13, 2):
+                qt.controlledNot(q, t, t + 1)
+            qt.pauliX(q, 16)         # mesh-coordinate bit: eager fallback
+            qt.rotateZ(q, 5, 0.3)
+
+        q1 = qt.createQureg(n, env8)
+        qt.initZeroState(q1)
+        with qt.gateFusion(q1):
+            qt.hadamard(q1, 0)
+            assert len(q1._fusion.gates) == 1
+            prog(q1)
+        got = np.asarray(q1.amps)
+        extra = qt.createQureg(n, env8)
+        qt.initZeroState(extra)
+        qt.hadamard(extra, 0)
+        prog(extra)
+        np.testing.assert_allclose(got, np.asarray(extra.amps), atol=1e-6)
+        assert abs(qt.calcTotalProb(q1) - 1.0) < 1e-5
+
+    def test_global_bit_gate_not_buffered(self):
+        env8 = qt.createQuESTEnv()
+        q = qt.createQureg(17, env8)
+        qt.initZeroState(q)
+        with qt.gateFusion(q):
+            qt.hadamard(q, 2)
+            assert len(q._fusion.gates) == 1
+            qt.hadamard(q, 15)   # >= nloc: drains, runs eagerly
+            assert len(q._fusion.gates) == 0
+        assert abs(qt.calcProbOfOutcome(q, 15, 0) - 0.5) < 1e-6
+        assert abs(qt.calcProbOfOutcome(q, 2, 0) - 0.5) < 1e-6
