@@ -320,9 +320,17 @@ def apply_window_stack(
     mid = 1 << (k - LANE_QUBITS)
     # batch hi first (contiguous super-blocks), then mid, to ~block_amps;
     # scale down with rank — the unrolled rank loop multiplies the scoped
-    # VMEM for temporaries (observed 18.4M > the 16M limit at rank 4 with
-    # 8 blocks; 16/rank blocks keeps ~9M with better matmul batching)
+    # VMEM for temporaries.  Empirical limits (16 MB scoped VMEM): rank-4
+    # A+B overflows at 8 blocks (18.4M) but fits at 4; rank-1 A+B
+    # overflows at 16 blocks (17.0M) but fits at 8; rank-1 B-only fits at
+    # 16 (fewer temporaries with the lane matmul skipped).
     block_amps = max(BLOCK_AMPS, 2 * block_amps // rank)
+    if rank == 1 and apply_a:
+        # 16 blocks with the lane matmul live sits right at the 16M scoped
+        # VMEM limit — it compiled in one program and overflowed (17.0M)
+        # in another for the SAME kernel config, so stay safely at 8;
+        # B-only passes (no lane matmul) keep 16
+        block_amps = min(block_amps, 8 * BLOCK_AMPS)
     R = min(hi, max(1, block_amps // BLOCK_AMPS))
     while hi % R:
         R //= 2

@@ -844,35 +844,37 @@ def apply_qft_ladder(amps, *, num_qubits: int, target: int, base: int = 0,
     lo = 1 << base         # untouched low axis (bra-twin case)
     hi = 1 << (n - 1 - t)
     dt = amps.dtype
-    # phase table e^{i*pi*low/mid} by recursive doubling: it is the
-    # Kronecker product over bits j of (1, e^{i*pi*2^j/mid}), so tr concat
-    # steps of complex multiplies build it — ~30x cheaper than 2^tr
-    # on-device cos/sin evaluations (which dominated the pass at tr ~ 25)
     sgn = -1.0 if conj else 1.0
-    c = jnp.ones((1,), dt)
-    s = jnp.zeros((1,), dt)
-    for j in range(tr):
-        ang = sgn * math.pi * (1 << j) / mid
+
+    # phase[low] = e^{i*pi*low/mid} by recursive doubling — the table is a
+    # Kronecker product over bits of (1, e^{i*pi*2^b/mid}), so tr concat
+    # steps of complex multiplies build it with no on-device
+    # transcendentals.  (A factored outer-product variant measured SLOWER
+    # end-to-end — XLA materializes the broadcast product anyway.)
+    mid_c = jnp.ones((1,), dt)
+    mid_s = jnp.zeros((1,), dt)
+    for b in range(tr):
+        ang = sgn * math.pi * (1 << b) / mid
         wr, wi = math.cos(ang), math.sin(ang)
-        c, s = (
-            jnp.concatenate([c, c * wr - s * wi]),
-            jnp.concatenate([s, s * wr + c * wi]),
+        mid_c, mid_s = (
+            jnp.concatenate([mid_c, mid_c * wr - mid_s * wi]),
+            jnp.concatenate([mid_s, mid_s * wr + mid_c * wi]),
         )
     inv = jnp.asarray(1.0 / math.sqrt(2.0), dt)
     if base == 0:
         v = amps.reshape(2, hi, 2, mid)
-        ph_shape = (1, mid)
+        ph = (1, mid)
     else:
         v = amps.reshape(2, hi, 2, mid, lo)
-        ph_shape = (1, mid, 1)
-    c = c.reshape(ph_shape)
-    s = s.reshape(ph_shape)
+        ph = (1, mid, 1)
+    pr = mid_c.reshape(ph)
+    pi_ = mid_s.reshape(ph)
     x0r, x0i = v[0, :, 0], v[1, :, 0]
     x1r, x1i = v[0, :, 1], v[1, :, 1]
     y0r, y0i = (x0r + x1r) * inv, (x0i + x1i) * inv
     y1r, y1i = (x0r - x1r) * inv, (x0i - x1i) * inv
-    z1r = c * y1r - s * y1i
-    z1i = c * y1i + s * y1r
+    z1r = pr * y1r - pi_ * y1i
+    z1i = pr * y1i + pi_ * y1r
     out = jnp.stack([
         jnp.stack([y0r, z1r], axis=1),
         jnp.stack([y0i, z1i], axis=1),
