@@ -9,7 +9,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench docs clean
 
 all: native
 
@@ -23,6 +23,9 @@ test: native
 
 bench: native
 	python bench.py
+
+docs:
+	python scripts/gen_api_reference.py
 
 clean:
 	rm -f $(NATIVE_SO)

@@ -82,6 +82,7 @@ def invalidQuESTInputError(errMsg: str, errFunc: str):
 
 
 def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
+    """Create a state-vector register of numQubits qubits (QuEST.h:529)."""
     V.validate_num_qubits(numQubits, "createQureg")
     q = Qureg(numQubits, env, is_density_matrix=False)
     q.amps = q.device_put(K.init_zero_state(q.num_amps_total, q.dtype))
@@ -89,6 +90,7 @@ def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
 
 
 def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
+    """Create a density-matrix register (state-vector of 2N qubits) (QuEST.h:623)."""
     V.validate_num_qubits(numQubits, "createDensityQureg")
     q = Qureg(numQubits, env, is_density_matrix=True)
     q.amps = q.device_put(
@@ -98,12 +100,14 @@ def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
 
 
 def createCloneQureg(qureg: Qureg, env: _env.QuESTEnv) -> Qureg:
+    """Create a new register cloning an existing one (QuEST.h:644)."""
     q = Qureg(qureg.num_qubits_represented, env, qureg.is_density_matrix)
     q.amps = jnp.array(qureg.amps, copy=True)
     return q
 
 
 def destroyQureg(qureg: Qureg, env: Optional[_env.QuESTEnv] = None) -> None:
+    """Free a register's amplitude storage (QuEST.h:666)."""
     qureg.amps = None
 
 
@@ -118,6 +122,7 @@ def reportState(qureg: Qureg) -> None:
 
 
 def reportStateToScreen(qureg: Qureg, env=None, reportRank: int = 0) -> None:
+    """Print all amplitudes to stdout (QuEST.h:1289)."""
     amps = np.asarray(qureg.amps)
     print("Reporting state from rank 0:")
     for re, im in zip(amps[0], amps[1]):
@@ -125,16 +130,19 @@ def reportStateToScreen(qureg: Qureg, env=None, reportRank: int = 0) -> None:
 
 
 def reportQuregParams(qureg: Qureg) -> None:
+    """Print register metadata (QuEST.h:1297)."""
     print(f"QUBITS:\nNumber of qubits is {qureg.num_qubits_represented}.")
     print(f"Number of amps is {qureg.num_amps_total}.")
     print(f"Number of amps per rank is {qureg.num_amps_per_chunk}.")
 
 
 def getNumQubits(qureg: Qureg) -> int:
+    """Number of qubits represented (QuEST.h:1333)."""
     return qureg.num_qubits_represented
 
 
 def getNumAmps(qureg: Qureg) -> int:
+    """Number of amplitudes (2^numQubits) (QuEST.h:1351)."""
     V.validate_state_vector(qureg, "getNumAmps")
     return qureg.num_amps_total
 
@@ -145,16 +153,19 @@ def getNumAmps(qureg: Qureg) -> int:
 
 
 def createComplexMatrixN(numQubits: int) -> np.ndarray:
+    """Allocate a 2^N x 2^N complex matrix (QuEST.h:721)."""
     V.validate_num_qubits(numQubits, "createComplexMatrixN")
     dim = 1 << numQubits
     return np.zeros((dim, dim), dtype=np.complex128)
 
 
 def destroyComplexMatrixN(matrix) -> None:
+    """Free a ComplexMatrixN (no-op placeholder for parity) (QuEST.h:739)."""
     pass
 
 
 def initComplexMatrixN(m: np.ndarray, reals, imags) -> None:
+    """Fill a ComplexMatrixN from real/imag nested lists (QuEST.h:764)."""
     m[...] = np.asarray(reals, dtype=np.float64) + 1j * np.asarray(imags, np.float64)
 
 
@@ -163,11 +174,13 @@ def getStaticComplexMatrixN(reals, imags) -> np.ndarray:
 
 
 def createPauliHamil(numQubits: int, numSumTerms: int) -> PauliHamil:
+    """Allocate a PauliHamil (flat pauli codes + term coefficients) (QuEST.h:802)."""
     V.validate_hamil_params(numQubits, numSumTerms, "createPauliHamil")
     return PauliHamil(numQubits, numSumTerms)
 
 
 def destroyPauliHamil(hamil: PauliHamil) -> None:
+    """Free a PauliHamil (QuEST.h:810)."""
     pass
 
 
@@ -200,6 +213,7 @@ def createPauliHamilFromFile(filename: str) -> PauliHamil:
 
 
 def initPauliHamil(hamil: PauliHamil, coeffs, codes) -> None:
+    """Fill a PauliHamil from coefficients and pauli codes (QuEST.h:897)."""
     V.validate_hamil_params(hamil.num_qubits, hamil.num_sum_terms, "initPauliHamil")
     codes = np.asarray(codes).reshape(hamil.num_sum_terms, hamil.num_qubits)
     V.validate_pauli_codes(codes.ravel(), "initPauliHamil")
@@ -208,17 +222,20 @@ def initPauliHamil(hamil: PauliHamil, coeffs, codes) -> None:
 
 
 def reportPauliHamil(hamil: PauliHamil) -> None:
+    """Print a PauliHamil in the reference text format (QuEST.h:1321)."""
     for t in range(hamil.num_sum_terms):
         codes = " ".join(str(int(c)) for c in hamil.pauli_codes[t])
         print(f"{hamil.term_coeffs[t]:g}\t{codes}")
 
 
 def createDiagonalOp(numQubits: int, env: _env.QuESTEnv) -> DiagonalOp:
+    """Allocate a distributed diagonal operator (QuEST.h:977)."""
     V.validate_num_qubits(numQubits, "createDiagonalOp")
     return DiagonalOp(numQubits, env)
 
 
 def destroyDiagonalOp(op: DiagonalOp, env=None) -> None:
+    """Free a DiagonalOp (QuEST.h:991)."""
     pass
 
 
@@ -228,6 +245,7 @@ def syncDiagonalOp(op: DiagonalOp) -> None:
 
 
 def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
+    """Fill a DiagonalOp from real/imag arrays (QuEST.h:1039)."""
     rdt = real_dtype()
     dim = 1 << op.num_qubits
     sharding = (
@@ -240,6 +258,7 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
 
 
 def setDiagonalOpElems(op: DiagonalOp, startInd: int, reals, imags, numElems: int) -> None:
+    """Overwrite a contiguous range of diagonal-operator elements (QuEST.h:1185)."""
     reals = np.asarray(reals, dtype=np.float64)[:numElems]
     imags = np.asarray(imags, dtype=np.float64)[:numElems]
     if startInd < 0 or startInd + numElems > (1 << op.num_qubits):
@@ -274,6 +293,7 @@ def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
 
 
 def createDiagonalOpFromPauliHamilFile(filename: str, env: _env.QuESTEnv) -> DiagonalOp:
+    """Build a diagonal operator from an all-Z PauliHamil file (QuEST.h:1137)."""
     hamil = createPauliHamilFromFile(filename)
     op = DiagonalOp(hamil.num_qubits, env)
     initDiagonalOpFromPauliHamil(op, hamil)
@@ -286,10 +306,12 @@ def createDiagonalOpFromPauliHamilFile(filename: str, env: _env.QuESTEnv) -> Dia
 
 
 def initBlankState(qureg: Qureg) -> None:
+    """Set all amplitudes to zero (QuEST.h:1361)."""
     qureg.amps = qureg.device_put(K.init_blank_state(qureg.num_amps_total, qureg.dtype))
 
 
 def initZeroState(qureg: Qureg) -> None:
+    """Set the register to |0...0> (QuEST.h:1375)."""
     if qureg.is_density_matrix:
         qureg.amps = qureg.device_put(
             K.init_classical_density(qureg.num_qubits_represented, 0, qureg.dtype)
@@ -300,6 +322,7 @@ def initZeroState(qureg: Qureg) -> None:
 
 
 def initPlusState(qureg: Qureg) -> None:
+    """Set the register to |+>^n (uniform superposition) (QuEST.h:1394)."""
     if qureg.is_density_matrix:
         qureg.amps = qureg.device_put(
             D.init_pure_state_density(
@@ -312,6 +335,7 @@ def initPlusState(qureg: Qureg) -> None:
 
 
 def initClassicalState(qureg: Qureg, stateInd: int) -> None:
+    """Set the register to a computational basis state (QuEST.h:1431)."""
     if stateInd < 0 or stateInd >= (1 << qureg.num_qubits_represented):
         raise V.QuESTError("initClassicalState: Invalid state index.")
     if qureg.is_density_matrix:
@@ -325,6 +349,7 @@ def initClassicalState(qureg: Qureg, stateInd: int) -> None:
 
 
 def initPureState(qureg: Qureg, pure: Qureg) -> None:
+    """Initialise a register (or rho = |psi><psi|) from a pure state (QuEST.h:1451)."""
     V.validate_state_vector(pure, "initPureState")
     V.validate_matching_qureg_dims(qureg, pure, "initPureState")
     if qureg.is_density_matrix:
@@ -336,10 +361,12 @@ def initPureState(qureg: Qureg, pure: Qureg) -> None:
 
 
 def initDebugState(qureg: Qureg) -> None:
+    """Set amplitude k to (2k mod ..)/10 + i(2k+1 mod ..)/10 (test oracle state) (QuEST.h:1463)."""
     qureg.amps = qureg.device_put(K.init_debug_state(qureg.num_amps_total, qureg.dtype))
 
 
 def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
+    """Set all amplitudes from real/imag arrays (QuEST.h:1490)."""
     re = np.asarray(reals, dtype=np.float64).ravel()
     im = np.asarray(imags, dtype=np.float64).ravel()
     if re.size != qureg.num_amps_total or im.size != qureg.num_amps_total:
@@ -348,6 +375,7 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
 
 
 def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
+    """Overwrite a contiguous range of amplitudes (QuEST.h:1537)."""
     V.validate_state_vector(qureg, "setAmps")
     V.validate_num_amps(qureg, startInd, numAmps, "setAmps")
     vals = np.stack(
@@ -370,6 +398,7 @@ def setDensityAmps(qureg: Qureg, reals, imags) -> None:
 
 
 def cloneQureg(targetQureg: Qureg, copyQureg: Qureg) -> None:
+    """Overwrite targetQureg with a copy of copyQureg (QuEST.h:1559)."""
     V.validate_matching_qureg_types(targetQureg, copyQureg, "cloneQureg")
     V.validate_matching_qureg_dims(targetQureg, copyQureg, "cloneQureg")
     targetQureg.amps = jnp.array(copyQureg.amps, copy=True)
@@ -480,12 +509,14 @@ def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
 
 
 def phaseShift(qureg: Qureg, targetQubit: int, angle: float) -> None:
+    """Shift the phase of the |1> amplitude of one qubit (QuEST.h:1595)."""
     V.validate_target(qureg, targetQubit, "phaseShift")
     _apply_diag(qureg, G.phase_shift_diag(angle), (targetQubit,))
     qureg.qasm_log.phase_shift(float(angle), (), targetQubit)
 
 
 def controlledPhaseShift(qureg: Qureg, idQubit1: int, idQubit2: int, angle: float) -> None:
+    """Controlled phase shift by the given angle (QuEST.h:1640)."""
     V.validate_control_target(qureg, idQubit1, idQubit2, "controlledPhaseShift")
     _apply_diag(qureg, G.phase_shift_diag(angle), (idQubit2,), (idQubit1,))
     qureg.qasm_log.phase_shift(float(angle), (idQubit1,), idQubit2)
@@ -501,12 +532,14 @@ def multiControlledPhaseShift(qureg: Qureg, controlQubits: Sequence[int], angle:
 
 
 def controlledPhaseFlip(qureg: Qureg, idQubit1: int, idQubit2: int) -> None:
+    """Controlled phase flip (controlled-Z) (QuEST.h:1723)."""
     V.validate_control_target(qureg, idQubit1, idQubit2, "controlledPhaseFlip")
     _apply_diag(qureg, G.Z_DIAG, (idQubit2,), (idQubit1,))
     qureg.qasm_log.gate("z", (idQubit1,), idQubit2)
 
 
 def multiControlledPhaseFlip(qureg: Qureg, controlQubits: Sequence[int]) -> None:
+    """Phase flip conditioned on all given qubits being 1 (QuEST.h:1768)."""
     qubits = [int(q) for q in controlQubits]
     V.validate_multi_qubits(qureg, qubits, "multiControlledPhaseFlip")
     _apply_diag(qureg, G.Z_DIAG, (qubits[-1],), tuple(qubits[:-1]))
@@ -514,18 +547,21 @@ def multiControlledPhaseFlip(qureg: Qureg, controlQubits: Sequence[int]) -> None
 
 
 def sGate(qureg: Qureg, targetQubit: int) -> None:
+    """Apply the S (phase) gate (QuEST.h:1801)."""
     V.validate_target(qureg, targetQubit, "sGate")
     _apply_diag(qureg, G.S_GATE_DIAG, (targetQubit,))
     qureg.qasm_log.gate("s", (), targetQubit)
 
 
 def tGate(qureg: Qureg, targetQubit: int) -> None:
+    """Apply the T (pi/8) gate (QuEST.h:1834)."""
     V.validate_target(qureg, targetQubit, "tGate")
     _apply_diag(qureg, G.T_GATE_DIAG, (targetQubit,))
     qureg.qasm_log.gate("t", (), targetQubit)
 
 
 def compactUnitary(qureg: Qureg, targetQubit: int, alpha, beta) -> None:
+    """Apply the compact unitary [[alpha, -conj(beta)], [beta, conj(alpha)]] (QuEST.h:2141)."""
     V.validate_target(qureg, targetQubit, "compactUnitary")
     alpha, beta = complex(alpha), complex(beta)
     if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
@@ -536,6 +572,7 @@ def compactUnitary(qureg: Qureg, targetQubit: int, alpha, beta) -> None:
 
 
 def unitary(qureg: Qureg, targetQubit: int, u) -> None:
+    """Arbitrary single-qubit unitary (QuEST.h:2182)."""
     V.validate_target(qureg, targetQubit, "unitary")
     V.validate_unitary(u, 1, "unitary")
     _apply_unitary(qureg, u, (targetQubit,))
@@ -561,6 +598,7 @@ def rotateZ(qureg: Qureg, rotQubit: int, angle: float) -> None:
 
 
 def rotateAroundAxis(qureg: Qureg, rotQubit: int, angle: float, axis) -> None:
+    """Rotation around an arbitrary Bloch axis (QuEST.h:2327)."""
     V.validate_target(qureg, rotQubit, "rotateAroundAxis")
     ax = _axis_vec(axis)
     V.validate_unit_vector(ax[0], ax[1], ax[2], "rotateAroundAxis")
@@ -593,6 +631,7 @@ def controlledRotateZ(qureg, controlQubit, targetQubit, angle) -> None:
 
 
 def controlledRotateAroundAxis(qureg, controlQubit, targetQubit, angle, axis) -> None:
+    """Controlled rotation around an arbitrary Bloch axis (QuEST.h:2486)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledRotateAroundAxis")
     ax = _axis_vec(axis)
     V.validate_unit_vector(ax[0], ax[1], ax[2], "controlledRotateAroundAxis")
@@ -602,6 +641,7 @@ def controlledRotateAroundAxis(qureg, controlQubit, targetQubit, angle, axis) ->
 
 
 def controlledCompactUnitary(qureg, controlQubit, targetQubit, alpha, beta) -> None:
+    """Controlled compact unitary (QuEST.h:2537)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledCompactUnitary")
     alpha, beta = complex(alpha), complex(beta)
     if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
@@ -614,6 +654,7 @@ def controlledCompactUnitary(qureg, controlQubit, targetQubit, alpha, beta) -> N
 
 
 def controlledUnitary(qureg, controlQubit, targetQubit, u) -> None:
+    """Controlled arbitrary single-qubit unitary (QuEST.h:2588)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledUnitary")
     V.validate_unitary(u, 1, "controlledUnitary")
     _apply_unitary(qureg, u, (targetQubit,), (controlQubit,))
@@ -621,6 +662,7 @@ def controlledUnitary(qureg, controlQubit, targetQubit, u) -> None:
 
 
 def multiControlledUnitary(qureg, controlQubits, targetQubit, u) -> None:
+    """Multi-controlled arbitrary single-qubit unitary (QuEST.h:2652)."""
     controls, target = [int(c) for c in controlQubits], int(targetQubit)
     V.validate_multi_controls_targets(qureg, controls, [target], "multiControlledUnitary")
     V.validate_unitary(u, 1, "multiControlledUnitary")
@@ -629,6 +671,7 @@ def multiControlledUnitary(qureg, controlQubits, targetQubit, u) -> None:
 
 
 def multiStateControlledUnitary(qureg, controlQubits, controlStates, targetQubit, u) -> None:
+    """Controlled unitary with per-control 0/1 condition states (QuEST.h:3877)."""
     controls = list(controlQubits)
     states = list(controlStates)
     V.validate_multi_controls_targets(qureg, controls, [targetQubit], "multiStateControlledUnitary")
@@ -639,36 +682,42 @@ def multiStateControlledUnitary(qureg, controlQubits, controlStates, targetQubit
 
 
 def pauliX(qureg: Qureg, targetQubit: int) -> None:
+    """Apply Pauli-X (QuEST.h:2689)."""
     V.validate_target(qureg, targetQubit, "pauliX")
     _apply_not(qureg, (targetQubit,), ())
     qureg.qasm_log.gate("x", (), targetQubit)
 
 
 def pauliY(qureg: Qureg, targetQubit: int) -> None:
+    """Apply Pauli-Y (QuEST.h:2724)."""
     V.validate_target(qureg, targetQubit, "pauliY")
     _apply_unitary(qureg, G.PAULI_Y, (targetQubit,))
     qureg.qasm_log.gate("y", (), targetQubit)
 
 
 def pauliZ(qureg: Qureg, targetQubit: int) -> None:
+    """Apply Pauli-Z (QuEST.h:2762)."""
     V.validate_target(qureg, targetQubit, "pauliZ")
     _apply_diag(qureg, G.Z_DIAG, (targetQubit,))
     qureg.qasm_log.gate("z", (), targetQubit)
 
 
 def hadamard(qureg: Qureg, targetQubit: int) -> None:
+    """Apply the Hadamard gate (QuEST.h:2794)."""
     V.validate_target(qureg, targetQubit, "hadamard")
     _apply_unitary(qureg, G.HADAMARD, (targetQubit,))
     qureg.qasm_log.gate("h", (), targetQubit)
 
 
 def controlledNot(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
+    """Controlled Pauli-X (CNOT) (QuEST.h:2838)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledNot")
     _apply_not(qureg, (targetQubit,), (controlQubit,))
     qureg.qasm_log.gate("x", (controlQubit,), targetQubit)
 
 
 def multiQubitNot(qureg: Qureg, targs: Sequence[int]) -> None:
+    """Pauli-X on several target qubits at once (QuEST.h:2971)."""
     targets = [int(t) for t in targs]
     V.validate_multi_qubits(qureg, targets, "multiQubitNot")
     _apply_not(qureg, tuple(targets), ())
@@ -677,6 +726,7 @@ def multiQubitNot(qureg: Qureg, targs: Sequence[int]) -> None:
 
 
 def multiControlledMultiQubitNot(qureg, ctrls, targs) -> None:
+    """Multi-controlled multi-target Pauli-X (QuEST.h:2914)."""
     controls, targets = [int(c) for c in ctrls], [int(t) for t in targs]
     V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiQubitNot")
     _apply_not(qureg, tuple(targets), tuple(controls))
@@ -702,6 +752,7 @@ def _apply_not(qureg, targets, controls, control_states=()):
 
 
 def controlledPauliY(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
+    """Controlled Pauli-Y (QuEST.h:3013)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledPauliY")
     _apply_unitary(qureg, G.PAULI_Y, (targetQubit,), (controlQubit,))
     qureg.qasm_log.gate("y", (controlQubit,), targetQubit)
@@ -714,6 +765,7 @@ _SWAP_SOA = np.stack([
 
 
 def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
+    """Swap two qubits' amplitudes (QuEST.h:3768)."""
     V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
     if _fusion.capture_unitary(qureg, _SWAP_SOA, (qubit1, qubit2)):
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
@@ -728,12 +780,14 @@ def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
 
 
 def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
+    """Apply the square-root-of-SWAP gate (QuEST.h:3816)."""
     V.validate_unique_targets(qureg, qb1, qb2, "sqrtSwapGate")
     _apply_unitary(qureg, G.SQRT_SWAP, (qb1, qb2))
     qureg.qasm_log.gate("sqrtswap", (qb1,), qb2)
 
 
 def multiRotateZ(qureg: Qureg, qubits: Sequence[int], angle: float) -> None:
+    """Rotation generated by a product of Z operators (parity phase) (QuEST.h:3912)."""
     qubits, angle = [int(q) for q in qubits], float(angle)
     V.validate_multi_qubits(qureg, qubits, "multiRotateZ")
     _apply_parity_phase(qureg, angle, tuple(qubits), ())
@@ -741,6 +795,7 @@ def multiRotateZ(qureg: Qureg, qubits: Sequence[int], angle: float) -> None:
 
 
 def multiControlledMultiRotateZ(qureg, controlQubits, targetQubits, angle) -> None:
+    """Multi-controlled Z-product rotation (QuEST.h:4037)."""
     controls, targets = list(controlQubits), list(targetQubits)
     V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiRotateZ")
     _apply_parity_phase(qureg, angle, tuple(targets), tuple(controls))
@@ -764,6 +819,7 @@ def _apply_parity_phase(qureg, angle, qubits, controls, conj=False):
 
 
 def multiRotatePauli(qureg: Qureg, targetQubits, targetPaulis, angle: float) -> None:
+    """Rotation generated by a product of Pauli operators (QuEST.h:3967)."""
     targets = [int(t) for t in targetQubits]
     paulis = [int(p) for p in targetPaulis]
     V.validate_multi_qubits(qureg, targets, "multiRotatePauli")
@@ -775,6 +831,7 @@ def multiRotatePauli(qureg: Qureg, targetQubits, targetPaulis, angle: float) -> 
 
 
 def multiControlledMultiRotatePauli(qureg, controlQubits, targetQubits, targetPaulis, angle) -> None:
+    """Multi-controlled Pauli-product rotation (QuEST.h:4138)."""
     controls = [int(c) for c in controlQubits]
     targets = [int(t) for t in targetQubits]
     paulis = [int(p) for p in targetPaulis]
@@ -813,6 +870,7 @@ def _multi_rotate_pauli(qureg, targets, paulis, angle, controls):
 
 
 def twoQubitUnitary(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> None:
+    """Arbitrary two-qubit unitary (QuEST.h:4353)."""
     V.validate_unique_targets(qureg, targetQubit1, targetQubit2, "twoQubitUnitary")
     V.validate_unitary(u, 2, "twoQubitUnitary")
     _apply_unitary(qureg, u, (targetQubit1, targetQubit2))
@@ -820,6 +878,7 @@ def twoQubitUnitary(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> No
 
 
 def controlledTwoQubitUnitary(qureg, controlQubit, targetQubit1, targetQubit2, u) -> None:
+    """Controlled arbitrary two-qubit unitary (QuEST.h:4420)."""
     V.validate_multi_controls_targets(
         qureg, [controlQubit], [targetQubit1, targetQubit2], "controlledTwoQubitUnitary"
     )
@@ -829,6 +888,7 @@ def controlledTwoQubitUnitary(qureg, controlQubit, targetQubit1, targetQubit2, u
 
 
 def multiControlledTwoQubitUnitary(qureg, controlQubits, targetQubit1, targetQubit2, u) -> None:
+    """Multi-controlled arbitrary two-qubit unitary (QuEST.h:4499)."""
     controls = list(controlQubits)
     V.validate_multi_controls_targets(
         qureg, controls, [targetQubit1, targetQubit2], "multiControlledTwoQubitUnitary"
@@ -839,6 +899,7 @@ def multiControlledTwoQubitUnitary(qureg, controlQubits, targetQubit1, targetQub
 
 
 def multiQubitUnitary(qureg: Qureg, targs: Sequence[int], u) -> None:
+    """Arbitrary unitary on N target qubits (QuEST.h:4582)."""
     targets = list(targs)
     V.validate_multi_qubits(qureg, targets, "multiQubitUnitary")
     V.validate_unitary(u, len(targets), "multiQubitUnitary")
@@ -847,6 +908,7 @@ def multiQubitUnitary(qureg: Qureg, targs: Sequence[int], u) -> None:
 
 
 def controlledMultiQubitUnitary(qureg, ctrl, targs, u) -> None:
+    """Controlled arbitrary multi-qubit unitary (QuEST.h:4655)."""
     targets = list(targs)
     V.validate_multi_controls_targets(qureg, [ctrl], targets, "controlledMultiQubitUnitary")
     V.validate_unitary(u, len(targets), "controlledMultiQubitUnitary")
@@ -855,6 +917,7 @@ def controlledMultiQubitUnitary(qureg, ctrl, targs, u) -> None:
 
 
 def multiControlledMultiQubitUnitary(qureg, ctrls, targs, u) -> None:
+    """Multi-controlled arbitrary multi-qubit unitary (QuEST.h:4744)."""
     controls, targets = list(ctrls), list(targs)
     V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiQubitUnitary")
     V.validate_unitary(u, len(targets), "multiControlledMultiQubitUnitary")

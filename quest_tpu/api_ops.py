@@ -38,6 +38,7 @@ from .api import (
 
 
 def calcProbOfOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
+    """Probability of measuring the given outcome of one qubit (QuEST.h:3047)."""
     V.validate_target(qureg, measureQubit, "calcProbOfOutcome")
     V.validate_outcome(outcome, "calcProbOfOutcome")
     if qureg.is_density_matrix:
@@ -53,6 +54,7 @@ def calcProbOfOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
 
 
 def calcProbOfAllOutcomes(qureg: Qureg, qubits: Sequence[int]) -> np.ndarray:
+    """Probabilities of every outcome of a sub-register measurement (QuEST.h:3136)."""
     qubits = [int(q) for q in qubits]
     V.validate_multi_qubits(qureg, qubits, "calcProbOfAllOutcomes")
     if qureg.is_density_matrix:
@@ -90,6 +92,7 @@ def _collapse(qureg: Qureg, qubit: int, outcome: int, prob: float) -> None:
 
 
 def collapseToOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
+    """Project one qubit to a known outcome and renormalise (QuEST.h:3170)."""
     V.validate_target(qureg, measureQubit, "collapseToOutcome")
     V.validate_outcome(outcome, "collapseToOutcome")
     prob = calcProbOfOutcome(qureg, measureQubit, outcome)
@@ -103,11 +106,13 @@ def collapseToOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
 
 
 def measure(qureg: Qureg, measureQubit: int) -> int:
+    """Measure one qubit, collapsing the state (QuEST.h:3194)."""
     outcome, _ = measureWithStats(qureg, measureQubit)
     return outcome
 
 
 def measureWithStats(qureg: Qureg, measureQubit: int):
+    """Measure one qubit, also returning the outcome probability (QuEST.h:3219)."""
     V.validate_target(qureg, measureQubit, "measureWithStats")
     zero_prob = calcProbOfOutcome(qureg, measureQubit, 0)
     outcome = _generate_measurement_outcome(zero_prob)
@@ -123,6 +128,7 @@ def measureWithStats(qureg: Qureg, measureQubit: int):
 
 
 def mixDephasing(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    """One-qubit dephasing channel (QuEST.h:3421)."""
     V.validate_density_matrix(qureg, "mixDephasing")
     V.validate_target(qureg, targetQubit, "mixDephasing")
     V.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability")
@@ -132,6 +138,7 @@ def mixDephasing(qureg: Qureg, targetQubit: int, prob: float) -> None:
 
 
 def mixTwoQubitDephasing(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
+    """Two-qubit dephasing channel (QuEST.h:3453)."""
     V.validate_density_matrix(qureg, "mixTwoQubitDephasing")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDephasing")
     V.validate_prob(prob, "mixTwoQubitDephasing", 0.75, "two-qubit dephasing probability")
@@ -148,6 +155,7 @@ def _mix_kraus(qureg: Qureg, ops, targets) -> None:
 
 
 def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    """One-qubit depolarising channel (QuEST.h:3496)."""
     V.validate_density_matrix(qureg, "mixDepolarising")
     V.validate_target(qureg, targetQubit, "mixDepolarising")
     V.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability")
@@ -155,6 +163,7 @@ def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
 
 
 def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
+    """One-qubit amplitude damping channel (QuEST.h:3534)."""
     V.validate_density_matrix(qureg, "mixDamping")
     V.validate_target(qureg, targetQubit, "mixDamping")
     V.validate_prob(prob, "mixDamping", 1.0, "damping probability")
@@ -162,6 +171,7 @@ def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
 
 
 def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
+    """Two-qubit depolarising channel (QuEST.h:3601)."""
     V.validate_density_matrix(qureg, "mixTwoQubitDepolarising")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDepolarising")
     V.validate_prob(prob, "mixTwoQubitDepolarising", 15.0 / 16, "two-qubit depolarising probability")
@@ -171,6 +181,7 @@ def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float)
 
 
 def mixPauli(qureg: Qureg, targetQubit: int, probX: float, probY: float, probZ: float) -> None:
+    """One-qubit Pauli channel with probabilities (pX, pY, pZ) (QuEST.h:3642)."""
     V.validate_density_matrix(qureg, "mixPauli")
     V.validate_target(qureg, targetQubit, "mixPauli")
     for p, nm in ((probX, "X"), (probY, "Y"), (probZ, "Z")):
@@ -181,6 +192,7 @@ def mixPauli(qureg: Qureg, targetQubit: int, probX: float, probY: float, probZ: 
 
 
 def mixDensityMatrix(combineQureg: Qureg, prob: float, otherQureg: Qureg) -> None:
+    """Mix another density matrix in: rho = (1-p) rho + p other (QuEST.h:3664)."""
     V.validate_density_matrix(combineQureg, "mixDensityMatrix")
     V.validate_density_matrix(otherQureg, "mixDensityMatrix")
     V.validate_matching_qureg_dims(combineQureg, otherQureg, "mixDensityMatrix")
@@ -189,6 +201,7 @@ def mixDensityMatrix(combineQureg: Qureg, prob: float, otherQureg: Qureg) -> Non
 
 
 def mixKrausMap(qureg: Qureg, target: int, ops, numOps: Optional[int] = None) -> None:
+    """Apply a one-qubit CPTP Kraus map (QuEST.h:4789)."""
     ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
     V.validate_density_matrix(qureg, "mixKrausMap")
     V.validate_target(qureg, target, "mixKrausMap")
@@ -197,6 +210,7 @@ def mixKrausMap(qureg: Qureg, target: int, ops, numOps: Optional[int] = None) ->
 
 
 def mixTwoQubitKrausMap(qureg: Qureg, target1: int, target2: int, ops, numOps: Optional[int] = None) -> None:
+    """Apply a two-qubit CPTP Kraus map (QuEST.h:4828)."""
     ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
     V.validate_density_matrix(qureg, "mixTwoQubitKrausMap")
     V.validate_unique_targets(qureg, target1, target2, "mixTwoQubitKrausMap")
@@ -205,6 +219,7 @@ def mixTwoQubitKrausMap(qureg: Qureg, target1: int, target2: int, ops, numOps: O
 
 
 def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops, numOps: Optional[int] = None) -> None:
+    """Apply an N-qubit CPTP Kraus map (QuEST.h:4878)."""
     ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
     targets = [int(t) for t in targets]
     V.validate_density_matrix(qureg, "mixMultiQubitKrausMap")
@@ -219,6 +234,7 @@ def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops, numOps: Opt
 
 
 def getAmp(qureg: Qureg, index: int) -> complex:
+    """Fetch one complex amplitude (QuEST.h:1987)."""
     V.validate_state_vector(qureg, "getAmp")
     V.validate_num_amps(qureg, index, 1, "getAmp")
     pair = np.asarray(qureg.amps[:, index])
@@ -226,19 +242,23 @@ def getAmp(qureg: Qureg, index: int) -> complex:
 
 
 def getRealAmp(qureg: Qureg, index: int) -> float:
+    """Fetch the real part of one amplitude (QuEST.h:2008)."""
     return getAmp(qureg, index).real
 
 
 def getImagAmp(qureg: Qureg, index: int) -> float:
+    """Fetch the imaginary part of one amplitude (QuEST.h:2029)."""
     return getAmp(qureg, index).imag
 
 
 def getProbAmp(qureg: Qureg, index: int) -> float:
+    """Fetch |amp|^2 of one amplitude (QuEST.h:2050)."""
     a = getAmp(qureg, index)
     return a.real * a.real + a.imag * a.imag
 
 
 def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
+    """Fetch one density-matrix element rho[row, col] (QuEST.h:2072)."""
     V.validate_density_matrix(qureg, "getDensityAmp")
     dim = 1 << qureg.num_qubits_represented
     if not (0 <= row < dim and 0 <= col < dim):
@@ -248,6 +268,7 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
 
 
 def calcTotalProb(qureg: Qureg) -> float:
+    """Total probability (trace / norm^2) of the register, Kahan-summed (QuEST.h:2099)."""
     if qureg.is_density_matrix:
         return float(
             C.calc_total_prob_density(qureg.amps, num_qubits=qureg.num_qubits_represented)
@@ -256,6 +277,7 @@ def calcTotalProb(qureg: Qureg) -> float:
 
 
 def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
+    """Complex inner product <bra|ket> of two state-vectors (QuEST.h:3246)."""
     V.validate_state_vector(bra, "calcInnerProduct")
     V.validate_state_vector(ket, "calcInnerProduct")
     V.validate_matching_qureg_dims(bra, ket, "calcInnerProduct")
@@ -264,6 +286,7 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
 
 
 def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
+    """Hilbert-Schmidt inner product Tr(rho1^dag rho2) of two density matrices (QuEST.h:3299)."""
     V.validate_density_matrix(rho1, "calcDensityInnerProduct")
     V.validate_density_matrix(rho2, "calcDensityInnerProduct")
     V.validate_matching_qureg_dims(rho1, rho2, "calcDensityInnerProduct")
@@ -271,11 +294,13 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
 
 
 def calcPurity(qureg: Qureg) -> float:
+    """Purity Tr(rho^2) of a density matrix (QuEST.h:3692)."""
     V.validate_density_matrix(qureg, "calcPurity")
     return float(C.calc_purity(qureg.amps))
 
 
 def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
+    """Fidelity of a register against a pure reference state (QuEST.h:3724)."""
     V.validate_state_vector(pureState, "calcFidelity")
     V.validate_matching_qureg_dims(qureg, pureState, "calcFidelity")
     if qureg.is_density_matrix:
@@ -289,6 +314,7 @@ def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
 
 
 def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
+    """Hilbert-Schmidt distance between two density matrices (QuEST.h:4911)."""
     V.validate_density_matrix(a, "calcHilbertSchmidtDistance")
     V.validate_density_matrix(b, "calcHilbertSchmidtDistance")
     V.validate_matching_qureg_dims(a, b, "calcHilbertSchmidtDistance")
@@ -304,6 +330,7 @@ def _full_codes(qureg, targets, codes) -> tuple:
 
 
 def calcExpecPauliProd(qureg: Qureg, targetQubits, pauliCodes, workspace: Optional[Qureg] = None) -> float:
+    """Expected value of a product of Pauli operators (uses workspace) (QuEST.h:4189)."""
     targets = [int(t) for t in targetQubits]
     codes = [int(c) for c in pauliCodes]
     V.validate_multi_qubits(qureg, targets, "calcExpecPauliProd")
@@ -324,6 +351,7 @@ def calcExpecPauliProd(qureg: Qureg, targetQubits, pauliCodes, workspace: Option
 
 
 def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Optional[Qureg] = None) -> float:
+    """Expected value of a weighted sum of Pauli products (uses workspace) (QuEST.h:4244)."""
     n = qureg.num_qubits_represented
     codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
     coeffs = np.asarray(termCoeffs, dtype=np.float64)
@@ -344,12 +372,14 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
 
 
 def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Optional[Qureg] = None) -> float:
+    """Expected value of a PauliHamil (uses workspace register) (QuEST.h:4285)."""
     V.validate_pauli_hamil(hamil, "calcExpecPauliHamil")
     V.validate_hamil_matches_qureg(hamil, qureg, "calcExpecPauliHamil")
     return calcExpecPauliSum(qureg, hamil.pauli_codes, hamil.term_coeffs, workspace)
 
 
 def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
+    """Expected value of a diagonal operator in the given state (QuEST.h:1255)."""
     V.validate_diag_op_matches_qureg(op, qureg, "calcExpecDiagonalOp")
     if qureg.is_density_matrix:
         r = np.asarray(
@@ -369,6 +399,7 @@ def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
 
 
 def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg, facOut, out: Qureg) -> None:
+    """out = f1 q1 + f2 q2 + fOut out (weighted register sum) (QuEST.h:4936)."""
     V.validate_matching_qureg_types(qureg1, qureg2, "setWeightedQureg")
     V.validate_matching_qureg_types(qureg1, out, "setWeightedQureg")
     V.validate_matching_qureg_dims(qureg1, qureg2, "setWeightedQureg")
@@ -393,18 +424,21 @@ def _apply_matrix_raw(qureg: Qureg, m, targets, controls=()):
 
 
 def applyMatrix2(qureg: Qureg, targetQubit: int, u) -> None:
+    """Left-multiply an arbitrary 2x2 matrix (no unitarity check, no density-matrix twin) (QuEST.h:5140)."""
     V.validate_target(qureg, targetQubit, "applyMatrix2")
     V.validate_matrix_size(u, 1, "applyMatrix2")
     _apply_matrix_raw(qureg, u, (targetQubit,))
 
 
 def applyMatrix4(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> None:
+    """Left-multiply an arbitrary 4x4 matrix (no unitarity check, no density-matrix twin) (QuEST.h:5192)."""
     V.validate_unique_targets(qureg, targetQubit1, targetQubit2, "applyMatrix4")
     V.validate_matrix_size(u, 2, "applyMatrix4")
     _apply_matrix_raw(qureg, u, (targetQubit1, targetQubit2))
 
 
 def applyMatrixN(qureg: Qureg, targs: Sequence[int], u) -> None:
+    """Left-multiply an arbitrary 2^N x 2^N matrix (no unitarity check, no density-matrix twin) (QuEST.h:5260)."""
     targets = [int(t) for t in targs]
     V.validate_multi_qubits(qureg, targets, "applyMatrixN")
     V.validate_matrix_size(u, len(targets), "applyMatrixN")
@@ -412,6 +446,7 @@ def applyMatrixN(qureg: Qureg, targs: Sequence[int], u) -> None:
 
 
 def applyMultiControlledMatrixN(qureg: Qureg, ctrls: Sequence[int], targs: Sequence[int], u) -> None:
+    """Left-multiply a controlled arbitrary matrix (no unitarity check, no twin) (QuEST.h:5313)."""
     controls = [int(c) for c in ctrls]
     targets = [int(t) for t in targs]
     V.validate_multi_controls_targets(qureg, controls, targets, "applyMultiControlledMatrixN")
@@ -420,6 +455,7 @@ def applyMultiControlledMatrixN(qureg: Qureg, ctrls: Sequence[int], targs: Seque
 
 
 def applyPauliSum(inQureg: Qureg, allPauliCodes, termCoeffs, outQureg: Qureg) -> None:
+    """Left-multiply a weighted sum of Pauli products, writing outQureg (QuEST.h:4995)."""
     n = inQureg.num_qubits_represented
     codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
     coeffs = np.asarray(termCoeffs, dtype=np.float64)
@@ -437,6 +473,7 @@ def applyPauliSum(inQureg: Qureg, allPauliCodes, termCoeffs, outQureg: Qureg) ->
 
 
 def applyPauliHamil(inQureg: Qureg, hamil: PauliHamil, outQureg: Qureg) -> None:
+    """Left-multiply a PauliHamil onto inQureg, writing outQureg (QuEST.h:5039)."""
     V.validate_pauli_hamil(hamil, "applyPauliHamil")
     V.validate_hamil_matches_qureg(hamil, inQureg, "applyPauliHamil")
     applyPauliSum(inQureg, hamil.pauli_codes, hamil.term_coeffs, outQureg)
@@ -527,10 +564,12 @@ def _pad_params(params, func_name, num_regs):
 
 
 def applyPhaseFunc(qureg: Qureg, qubits, encoding, coeffs, exponents) -> None:
+    """Apply exp(i coeff * x^exp) phases from the index of one sub-register (QuEST.h:5571)."""
     applyPhaseFuncOverrides(qureg, qubits, encoding, coeffs, exponents, None, None)
 
 
 def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents, overrideInds, overridePhases) -> None:
+    """Single-variable phase function with explicit per-index overrides (QuEST.h:5682)."""
     qubits = [int(q) for q in qubits]
     V.validate_multi_qubits(qureg, qubits, "applyPhaseFunc")
     V.validate_bit_encoding(int(encoding), "applyPhaseFunc")
@@ -545,6 +584,7 @@ def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents, o
 
 
 def applyMultiVarPhaseFunc(qureg: Qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg) -> None:
+    """Apply exp(i sum_r coeff * x_r^exp) over multiple sub-register variables (QuEST.h:5843)."""
     applyMultiVarPhaseFuncOverrides(
         qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg, None, None
     )
@@ -561,6 +601,7 @@ def _split_regs(qubits, numQubitsPerReg):
 
 
 def applyMultiVarPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg, overrideInds, overridePhases) -> None:
+    """Multi-variable phase function with explicit per-index phase overrides (QuEST.h:5925)."""
     regs = _split_regs(qubits, numQubitsPerReg)
     for r in regs:
         V.validate_multi_qubits(qureg, list(r), "applyMultiVarPhaseFunc")
@@ -579,12 +620,14 @@ def applyMultiVarPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, co
 
 
 def applyNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionNameCode) -> None:
+    """Apply one of the 14 named phase functions over sub-register variables (QuEST.h:6065)."""
     applyParamNamedPhaseFuncOverrides(
         qureg, qubits, numQubitsPerReg, encoding, functionNameCode, None, None, None
     )
 
 
 def applyNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, overrideInds, overridePhases) -> None:
+    """Named phase function with explicit per-index phase overrides (QuEST.h:6138)."""
     applyParamNamedPhaseFuncOverrides(
         qureg, qubits, numQubitsPerReg, encoding, functionNameCode, None,
         overrideInds, overridePhases,
@@ -592,12 +635,14 @@ def applyNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, funct
 
 
 def applyParamNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params) -> None:
+    """Named phase function with extra scalar parameters (QuEST.h:6251)."""
     applyParamNamedPhaseFuncOverrides(
         qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params, None, None
     )
 
 
 def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params, overrideInds, overridePhases, *, _conj=False) -> None:
+    """Parameterised named phase function with per-index overrides (QuEST.h:6326)."""
     regs = _split_regs(qubits, numQubitsPerReg)
     for r in regs:
         V.validate_multi_qubits(
@@ -628,12 +673,14 @@ def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, 
 
 
 def applyQFT(qureg: Qureg, qubits: Sequence[int], numQubits: Optional[int] = None) -> None:
+    """Apply the quantum Fourier transform to the given qubits (QuEST.h:6536)."""
     qubits = [int(q) for q in qubits]
     V.validate_multi_qubits(qureg, qubits, "applyQFT")
     _apply_qft(qureg, qubits)
 
 
 def applyFullQFT(qureg: Qureg) -> None:
+    """Apply the quantum Fourier transform to every qubit (QuEST.h:6420)."""
     _apply_qft(qureg, list(range(qureg.num_qubits_represented)))
 
 
@@ -713,22 +760,27 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
 
 
 def startRecordingQASM(qureg: Qureg) -> None:
+    """Begin recording API gates as OPENQASM 2.0 (QuEST.h:3351)."""
     qureg.qasm_log.start()
 
 
 def stopRecordingQASM(qureg: Qureg) -> None:
+    """Stop recording QASM (QuEST.h:3362)."""
     qureg.qasm_log.stop()
 
 
 def clearRecordedQASM(qureg: Qureg) -> None:
+    """Clear the register's recorded QASM buffer (QuEST.h:3370)."""
     qureg.qasm_log.clear()
 
 
 def printRecordedQASM(qureg: Qureg) -> None:
+    """Print the recorded QASM to stdout (QuEST.h:3379)."""
     print(str(qureg.qasm_log), end="")
 
 
 def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
+    """Write the recorded QASM to a file (QuEST.h:3390)."""
     try:
         with open(filename, "w") as f:
             f.write(str(qureg.qasm_log))

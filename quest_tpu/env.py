@@ -116,6 +116,7 @@ def sync_quest_success(success_code: int = 1) -> int:
 
 
 def report_quest_env(env: QuESTEnv) -> None:
+    """Print execution-environment parameters (QuEST.h:1893)."""
     print(get_environment_string(env))
 
 
