@@ -201,10 +201,17 @@ def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
     # row index = 2*b1 + b0; regroup to T[(b1,b1'),(b0,b0')]
     t = u.reshape(2, 2, 2, 2).transpose(0, 2, 1, 3).reshape(4, 4)
     uu, s, vh = np.linalg.svd(t)
+    # Truncation threshold scales with the dtype's working precision and is
+    # relative to the largest singular value: a fixed 1e-7 would silently
+    # flatten small-angle f64 controlled rotations to rank 1 (~1e-7 error
+    # where eager f64 dispatch gives ~1e-16).  A zero matrix keeps its
+    # leading (zero) term so the rank is always >= 1 and fold_cross never
+    # sees an empty decomposition.
+    eps = _SCHMIDT_TOL if m.dtype == np.float32 else 1e-12
+    tol = eps * max(float(s[0]), 1.0)
+    keep = [r for r in range(4) if s[r] > tol] or [0]
     terms = []
-    for r in range(4):
-        if s[r] <= _SCHMIDT_TOL:
-            continue
+    for r in keep:
         hi = (np.sqrt(s[r]) * uu[:, r]).reshape(2, 2)
         lo = (np.sqrt(s[r]) * vh[r, :]).reshape(2, 2)
         terms.append(

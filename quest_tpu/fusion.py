@@ -58,19 +58,25 @@ def start_gate_fusion(qureg) -> None:
 
 
 def stop_gate_fusion(qureg) -> None:
-    """Drain any buffered gates and stop buffering."""
-    buf = getattr(qureg, "_fusion", None)
+    """Drain any buffered gates and stop buffering.  If execution fails the
+    buffer stays attached with its gates intact, so state and QASM log
+    cannot silently diverge."""
+    drain(qureg)
     qureg._fusion = None
-    if buf is not None and buf.gates:
-        _run(qureg, buf.gates)
 
 
 def drain(qureg) -> None:
-    """Execute buffered gates now (called from the Qureg.amps property)."""
+    """Execute buffered gates now (called from the Qureg.amps property).
+    On failure the gates are restored to the buffer — a failed drain must
+    not be silently absorbed into a state/log divergence."""
     buf = getattr(qureg, "_fusion", None)
     if buf is not None and buf.gates:
         gates, buf.gates = buf.gates, []
-        _run(qureg, gates)
+        try:
+            _run(qureg, gates)
+        except BaseException:
+            buf.gates = gates + buf.gates
+            raise
 
 
 def _run(qureg, gates) -> None:

@@ -255,6 +255,30 @@ class TestWindowedScheduler:
         dense = cplx.soa(random_unitary(2, rng)).astype(np.float32)
         assert len(C.schmidt_terms_2q(dense)) == 4
 
+    def test_schmidt_small_angle_f64_keeps_rank2(self):
+        # ADVICE r1: a fixed 1e-7 truncation silently flattened f64
+        # controlled rotations with angle < ~1e-7 to rank 1
+        theta = 1e-9
+        cp = np.diag([1, 1, 1, np.exp(1j * theta)])
+        terms = C.schmidt_terms_2q(cplx.soa(cp).astype(np.float64))
+        assert len(terms) == 2
+        acc = np.zeros((4, 4), complex)
+        for lo, hi in terms:
+            acc += np.kron(hi[0] + 1j * hi[1], lo[0] + 1j * lo[1])
+        np.testing.assert_allclose(acc, cp, atol=1e-14)
+
+    def test_schmidt_zero_matrix_rank1(self):
+        # ADVICE r1: empty decompositions must not reach fold_cross
+        zero = np.zeros((2, 4, 4), np.float64)
+        terms = C.schmidt_terms_2q(zero)
+        assert len(terms) == 1
+        gates = [C.Gate((0, 9), zero)]
+        ops = C.plan_circuit(gates, 12)
+        amps = np.zeros((2, 1 << 12), np.float64)
+        amps[0, 0] = 1.0
+        out = np.asarray(C.execute_plan(jnp.asarray(amps), ops, 12))
+        np.testing.assert_allclose(out, 0.0, atol=1e-15)
+
     def test_schmidt_reconstruction(self):
         rng = np.random.default_rng(22)
         for u in [CNOT, random_unitary(2, rng)]:

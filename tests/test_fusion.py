@@ -145,6 +145,28 @@ class TestSideChannels:
 
 
 class TestReviewRegressions:
+    def test_failed_drain_restores_buffer(self, env, monkeypatch):
+        # ADVICE r1: a drain that raises must not lose the buffered gates
+        from quest_tpu import fusion as F
+
+        q = qt.createQureg(N, env)
+        qt.initZeroState(q)
+        qt.startGateFusion(q)
+        qt.pauliX(q, 0)
+        qt.hadamard(q, 1)
+        assert len(q._fusion.gates) == 2
+
+        def boom(qureg, gates):
+            raise RuntimeError("injected drain failure")
+
+        monkeypatch.setattr(F, "_run", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            F.drain(q)
+        assert len(q._fusion.gates) == 2  # restored, not lost
+        monkeypatch.undo()
+        qt.stopGateFusion(q)
+        assert qt.calcProbOfOutcome(q, 0, 1) == pytest.approx(1.0)
+
     def test_nested_contexts_keep_outer_buffering(self, env):
         q = qt.createQureg(N, env)
         qt.initZeroState(q)
