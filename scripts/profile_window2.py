@@ -1,0 +1,79 @@
+"""Window-pass cost with relay-overhead-free K-differencing.
+
+A scalar fetch through the axon relay costs ~100 ms, so absolute chain
+timings are dominated by it.  T(K2) - T(K1) cancels the fetch and the
+dispatch, leaving (K2-K1) passes of pure device time.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from quest_tpu.ops import fused
+
+N = 26
+AMPS = 1 << N
+BYTES_PER_PASS = 2 * 2 * 4 * AMPS
+K1, K2 = 10, 40
+REPS = 3
+
+
+def rand_u(rng, d):
+    m = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
+    q, _ = np.linalg.qr(m)
+    return np.stack([q.real, q.imag]).astype(np.float32)
+
+
+def chain_fn(K, rank, apply_a, apply_b, precision, k):
+    kwargs = dict(num_qubits=N, k=k, apply_a=apply_a, apply_b=apply_b,
+                  precision=precision)
+
+    @jax.jit
+    def chain(a, ma, mb):
+        for _ in range(K):
+            a = fused.apply_window_stack(a, ma, mb, **kwargs)
+        return a[0, 0]
+
+    return chain
+
+
+def bench(label, rank, apply_a=True, apply_b=True, precision="highest", k=7):
+    rng = np.random.default_rng(0)
+    ma = jnp.asarray(np.stack([rand_u(rng, 128) for _ in range(rank)]))
+    mb = jnp.asarray(np.stack([rand_u(rng, 128) for _ in range(rank)]))
+    a = jnp.zeros((2, AMPS), jnp.float32).at[0, 0].set(1.0)
+    c1 = chain_fn(K1, rank, apply_a, apply_b, precision, k)
+    c2 = chain_fn(K2, rank, apply_a, apply_b, precision, k)
+    try:
+        float(c1(a, ma, mb)); float(c2(a, ma, mb))  # compile+warm
+        best = None
+        for _ in range(REPS):
+            t0 = time.perf_counter(); float(c1(a, ma, mb)); t1 = time.perf_counter() - t0
+            t0 = time.perf_counter(); float(c2(a, ma, mb)); t2 = time.perf_counter() - t0
+            dt = (t2 - t1) / (K2 - K1)
+            best = dt if best is None else min(best, dt)
+    except Exception as e:
+        print(f"{label:40s} FAILED: {type(e).__name__}: {str(e)[:100]}")
+        return None
+    gbs = BYTES_PER_PASS / best / 1e9
+    print(f"{label:40s} {best*1e3:7.2f} ms/pass  {gbs:7.1f} GB/s")
+    return best
+
+
+if __name__ == "__main__":
+    print(f"backend={jax.default_backend()}  n={N}  diff K={K1}->{K2}, best of {REPS}")
+    bench("rank1 A+B  highest", 1)
+    bench("rank1 A+B  default", 1, precision="default")
+    bench("rank1 B-only highest", 1, apply_a=False)
+    bench("rank1 A-only highest", 1, apply_b=False)
+    bench("rank2 A+B  highest", 2)
+    bench("rank4 A+B  highest", 4)
+    bench("rank2 A+B  default", 2, precision="default")
+    bench("rank4 A+B  default", 4, precision="default")
+    bench("rank1 A+B  highest k=13", 1, k=13)
+    bench("rank1 A+B  highest k=19", 1, k=19)

@@ -351,15 +351,112 @@ class TestWindowedScheduler:
         np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+class TestMaskScheduling:
+    """Diagonal-mask folding of crossing controlled gates (round 2):
+    controlled-form 2q gates rewrite to W-sandwich + diagonal, and crossing
+    diagonals fold into the pass's elementwise mask at zero rank cost."""
+
+    def test_controlled_form_cnot(self):
+        cf = C.controlled_form_2q(cplx.soa(CNOT).astype(np.float64))
+        assert cf is not None
+        pre, d4, post, acted = cf
+        # reconstruct: U = (post on acted) . diag(d4) . (pre on acted)
+        pre_c = pre[0] + 1j * pre[1]
+        post_c = post[0] + 1j * post[1]
+        d = d4[0] + 1j * d4[1]
+        if acted == 1:
+            full_pre = np.kron(pre_c, np.eye(2))
+            full_post = np.kron(post_c, np.eye(2))
+        else:
+            full_pre = np.kron(np.eye(2), pre_c)
+            full_post = np.kron(np.eye(2), post_c)
+        u = full_post @ np.diag(d) @ full_pre
+        np.testing.assert_allclose(u, CNOT, atol=1e-12)
+
+    def test_controlled_form_random_controlled_v(self):
+        rng = np.random.default_rng(9)
+        for ctrl_bit in (0, 1):
+            v = random_unitary(1, rng)
+            u = np.eye(4, dtype=complex)
+            if ctrl_bit == 0:           # control = matrix bit 0
+                u[1::2, 1::2] = v
+            else:                       # control = matrix bit 1
+                u[2:, 2:] = v
+            cf = C.controlled_form_2q(cplx.soa(u).astype(np.float64))
+            assert cf is not None and cf[3] == 1 - ctrl_bit
+        # generic dense 2q gate is NOT controlled-form
+        dense = cplx.soa(random_unitary(2, rng)).astype(np.float64)
+        assert C.controlled_form_2q(dense) is None
+        # a fully diagonal gate is excluded (handled by diag4_2q directly)
+        cz = np.diag([1, 1, 1, -1]).astype(complex)
+        assert C.controlled_form_2q(cplx.soa(cz)) is None
+        assert C.diag4_2q(cplx.soa(cz)) is not None
+
+    def test_ladder_plan_is_all_rank1(self):
+        # the headline circuit shape: every crossing CNOT must fold via the
+        # mask, leaving every window pass at rank 1
+        rng = np.random.default_rng(11)
+        n, depth = 16, 4
+        gates = _layered_circuit(rng, n, depth)
+        ops = C.plan_circuit_windowed(gates, n)
+        for op in ops:
+            assert op[0] == "winfused"
+            assert np.shape(op[2])[0] == 1      # rank 1
+        assert any(len(op) > 6 and op[6] is not None for op in ops)
+
+    def test_masked_plan_matches_gatewise(self):
+        rng = np.random.default_rng(12)
+        n = 15
+        gates = _layered_circuit(rng, n, 3)
+        # add crossing CPhase (diagonal, masks directly) and a
+        # control-on-low CRz
+        cphase = np.diag([1, 1, 1, np.exp(0.7j)]).astype(complex)
+        gates.append(C.Gate((3, 9), cplx.soa(cphase).astype(np.float32)))
+        crz = np.eye(4, dtype=complex)
+        crz[1, 1], crz[3, 3] = np.exp(-0.4j), np.exp(0.4j)
+        gates.append(C.Gate((2, 14), cplx.soa(crz).astype(np.float32)))
+        amps0 = _rand_state(rng, n)
+        ops = C.plan_circuit_windowed(gates, n)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_mask_only_pass(self):
+        # a lone crossing CZ: pass with no matmul on either side, just mask
+        n = 14
+        cz = np.zeros((2, 4, 4), np.float64)
+        cz[0] = np.diag([1, 1, 1, -1])
+        gates = [C.Gate((0, 13), cz)]
+        ops = C.plan_circuit_windowed(gates, n)
+        assert len(ops) == 1 and ops[0][6] is not None
+        rng = np.random.default_rng(13)
+        amps0 = _rand_state(rng, n)
+        out = np.asarray(C.execute_plan(jnp.asarray(amps0), ops, n))
+        ref = _apply_gatewise(amps0, gates, n)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
 class TestNativeWindowedScheduler:
     """Parity of the C++ windowed planner (qts_plan_windowed) with the
     Python reference implementation plan_circuit_windowed."""
 
     @pytest.mark.parametrize("n,depth", [(14, 2), (16, 3), (20, 2)])
     def test_plans_match_python(self, n, depth):
+        # generic dense 2q gates only: controlled-form/diagonal gates take
+        # the Python planner's mask path, which the C++ planner does not
+        # model (plan_circuit prefers Python for those circuits)
         rng = np.random.default_rng(400 + n)
-        gates = _layered_circuit(rng, n, depth)
-        gates.append(C.Gate((2, n - 1), cplx.soa(CNOT).astype(np.float32)))
+        gates = []
+        for d in range(depth):
+            for q in range(n):
+                gates.append(C.Gate(
+                    (q,), cplx.soa(random_unitary(1, rng)).astype(np.float32)))
+            for q in range(d % 2, n - 1, 2):
+                gates.append(C.Gate(
+                    (q, q + 1),
+                    cplx.soa(random_unitary(2, rng)).astype(np.float32)))
+        gates.append(C.Gate(
+            (2, n - 1), cplx.soa(random_unitary(2, rng)).astype(np.float32)))
         py = C.plan_circuit_windowed(gates, n)
         structural = native.plan_native_windowed(
             [g.targets for g in gates], n, C._gate_xranks(gates))
@@ -373,7 +470,8 @@ class TestNativeWindowedScheduler:
                     np.asarray(a[2]), np.asarray(b[2]), atol=1e-6)
                 np.testing.assert_allclose(
                     np.asarray(a[3]), np.asarray(b[3]), atol=1e-6)
-                assert a[4:] == b[4:]        # same apply_a/apply_b flags
+                assert a[4:6] == b[4:6]      # same apply_a/apply_b flags
+                assert len(a) < 7 or a[6] is None   # no mask on these plans
             else:
                 assert tuple(a[1]) == tuple(b[1])
 
