@@ -186,28 +186,33 @@ def destroyPauliHamil(hamil: PauliHamil) -> None:
 
 def createPauliHamilFromFile(filename: str) -> PauliHamil:
     """Text format: per line 'coeff code_0 code_1 ... code_{n-1}'
-    (reference parser, QuEST.c:1405-1488)."""
+    (reference parser, QuEST.c:1405-1488; file-specific error codes from
+    QuEST_validation.c:539-545, 660-697)."""
+    func = "createPauliHamilFromFile"
     try:
         with open(filename) as f:
             lines = [ln.split() for ln in f if ln.strip()]
     except OSError:
-        raise V.QuESTError(
-            f"createPauliHamilFromFile: Could not open file {filename}"
-        )
-    if not lines:
-        raise V.QuESTError("createPauliHamilFromFile: Empty Hamiltonian file.")
-    num_qubits = len(lines[0]) - 1
+        V.validate_file_opened(False, filename, func)
+    num_qubits = len(lines[0]) - 1 if lines else 0
     num_terms = len(lines)
-    V.validate_hamil_params(num_qubits, num_terms, "createPauliHamilFromFile")
+    V.validate_hamil_file_params(num_qubits, num_terms, filename, func)
     h = PauliHamil(num_qubits, num_terms)
     for t, toks in enumerate(lines):
-        if len(toks) != num_qubits + 1:
-            raise V.QuESTError(
-                "createPauliHamilFromFile: Inconsistent number of Pauli codes."
-            )
-        h.term_coeffs[t] = float(toks[0])
-        codes = [int(x) for x in toks[1:]]
-        V.validate_pauli_codes(codes, "createPauliHamilFromFile")
+        V.validate_hamil_file_pauli_parsed(len(toks) == num_qubits + 1,
+                                           filename, func)
+        try:
+            h.term_coeffs[t] = float(toks[0])
+        except ValueError:
+            V.validate_hamil_file_coeff_parsed(False, filename, func)
+        codes = []
+        for x in toks[1:]:
+            try:
+                codes.append(int(x))
+            except ValueError:
+                V.validate_hamil_file_pauli_parsed(False, filename, func)
+        for c in codes:
+            V.validate_hamil_file_pauli_code(c, filename, func)
         h.pauli_codes[t, :] = codes
     return h
 
@@ -366,7 +371,9 @@ def initDebugState(qureg: Qureg) -> None:
 
 
 def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
-    """Set all amplitudes from real/imag arrays (QuEST.h:1490)."""
+    """Set all amplitudes from real/imag arrays (QuEST.h:1490;
+    state-vectors only, QuEST.c:157-158)."""
+    V.validate_state_vector(qureg, "initStateFromAmps")
     re = np.asarray(reals, dtype=np.float64).ravel()
     im = np.asarray(imags, dtype=np.float64).ravel()
     if re.size != qureg.num_amps_total or im.size != qureg.num_amps_total:
@@ -664,7 +671,7 @@ def controlledUnitary(qureg, controlQubit, targetQubit, u) -> None:
 def multiControlledUnitary(qureg, controlQubits, targetQubit, u) -> None:
     """Multi-controlled arbitrary single-qubit unitary (QuEST.h:2652)."""
     controls, target = [int(c) for c in controlQubits], int(targetQubit)
-    V.validate_multi_controls_targets(qureg, controls, [target], "multiControlledUnitary")
+    V.validate_multi_controls_target(qureg, controls, target, "multiControlledUnitary")
     V.validate_unitary(u, 1, "multiControlledUnitary")
     _apply_unitary(qureg, u, (target,), tuple(controls))
     qureg.qasm_log.unitary_2x2(np.asarray(u, complex), tuple(controls), target)
@@ -674,7 +681,7 @@ def multiStateControlledUnitary(qureg, controlQubits, controlStates, targetQubit
     """Controlled unitary with per-control 0/1 condition states (QuEST.h:3877)."""
     controls = list(controlQubits)
     states = list(controlStates)
-    V.validate_multi_controls_targets(qureg, controls, [targetQubit], "multiStateControlledUnitary")
+    V.validate_multi_controls_target(qureg, controls, targetQubit, "multiStateControlledUnitary")
     V.validate_control_states(controls, states, "multiStateControlledUnitary")
     V.validate_unitary(u, 1, "multiStateControlledUnitary")
     _apply_unitary(qureg, u, (targetQubit,), tuple(controls), tuple(states))
@@ -719,7 +726,7 @@ def controlledNot(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
 def multiQubitNot(qureg: Qureg, targs: Sequence[int]) -> None:
     """Pauli-X on several target qubits at once (QuEST.h:2971)."""
     targets = [int(t) for t in targs]
-    V.validate_multi_qubits(qureg, targets, "multiQubitNot")
+    V.validate_multi_targets(qureg, targets, "multiQubitNot")
     _apply_not(qureg, tuple(targets), ())
     for t in targets:
         qureg.qasm_log.gate("x", (), t)
@@ -789,7 +796,7 @@ def sqrtSwapGate(qureg: Qureg, qb1: int, qb2: int) -> None:
 def multiRotateZ(qureg: Qureg, qubits: Sequence[int], angle: float) -> None:
     """Rotation generated by a product of Z operators (parity phase) (QuEST.h:3912)."""
     qubits, angle = [int(q) for q in qubits], float(angle)
-    V.validate_multi_qubits(qureg, qubits, "multiRotateZ")
+    V.validate_multi_targets(qureg, qubits, "multiRotateZ")
     _apply_parity_phase(qureg, angle, tuple(qubits), ())
     qureg.qasm_log.comment(f"multiRotateZ(angle={angle:g}) on qubits {qubits}")
 
@@ -822,7 +829,7 @@ def multiRotatePauli(qureg: Qureg, targetQubits, targetPaulis, angle: float) -> 
     """Rotation generated by a product of Pauli operators (QuEST.h:3967)."""
     targets = [int(t) for t in targetQubits]
     paulis = [int(p) for p in targetPaulis]
-    V.validate_multi_qubits(qureg, targets, "multiRotatePauli")
+    V.validate_multi_targets(qureg, targets, "multiRotatePauli")
     V.validate_pauli_codes(paulis, "multiRotatePauli")
     _multi_rotate_pauli(qureg, targets, paulis, float(angle), controls=())
     qureg.qasm_log.comment(
@@ -873,6 +880,7 @@ def twoQubitUnitary(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> No
     """Arbitrary two-qubit unitary (QuEST.h:4353)."""
     V.validate_unique_targets(qureg, targetQubit1, targetQubit2, "twoQubitUnitary")
     V.validate_unitary(u, 2, "twoQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, 2, "twoQubitUnitary")
     _apply_unitary(qureg, u, (targetQubit1, targetQubit2))
     qureg.qasm_log.comment("twoQubitUnitary applied")
 
@@ -883,6 +891,7 @@ def controlledTwoQubitUnitary(qureg, controlQubit, targetQubit1, targetQubit2, u
         qureg, [controlQubit], [targetQubit1, targetQubit2], "controlledTwoQubitUnitary"
     )
     V.validate_unitary(u, 2, "controlledTwoQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, 2, "controlledTwoQubitUnitary")
     _apply_unitary(qureg, u, (targetQubit1, targetQubit2), (controlQubit,))
     qureg.qasm_log.comment("controlledTwoQubitUnitary applied")
 
@@ -894,6 +903,7 @@ def multiControlledTwoQubitUnitary(qureg, controlQubits, targetQubit1, targetQub
         qureg, controls, [targetQubit1, targetQubit2], "multiControlledTwoQubitUnitary"
     )
     V.validate_unitary(u, 2, "multiControlledTwoQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, 2, "multiControlledTwoQubitUnitary")
     _apply_unitary(qureg, u, (targetQubit1, targetQubit2), tuple(controls))
     qureg.qasm_log.comment("multiControlledTwoQubitUnitary applied")
 
@@ -901,8 +911,9 @@ def multiControlledTwoQubitUnitary(qureg, controlQubits, targetQubit1, targetQub
 def multiQubitUnitary(qureg: Qureg, targs: Sequence[int], u) -> None:
     """Arbitrary unitary on N target qubits (QuEST.h:4582)."""
     targets = list(targs)
-    V.validate_multi_qubits(qureg, targets, "multiQubitUnitary")
+    V.validate_multi_targets(qureg, targets, "multiQubitUnitary")
     V.validate_unitary(u, len(targets), "multiQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, len(targets), "multiQubitUnitary")
     _apply_unitary(qureg, u, tuple(targets))
     qureg.qasm_log.comment("multiQubitUnitary applied")
 
@@ -912,6 +923,7 @@ def controlledMultiQubitUnitary(qureg, ctrl, targs, u) -> None:
     targets = list(targs)
     V.validate_multi_controls_targets(qureg, [ctrl], targets, "controlledMultiQubitUnitary")
     V.validate_unitary(u, len(targets), "controlledMultiQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, len(targets), "controlledMultiQubitUnitary")
     _apply_unitary(qureg, u, tuple(targets), (ctrl,))
     qureg.qasm_log.comment("controlledMultiQubitUnitary applied")
 
@@ -921,6 +933,7 @@ def multiControlledMultiQubitUnitary(qureg, ctrls, targs, u) -> None:
     controls, targets = list(ctrls), list(targs)
     V.validate_multi_controls_targets(qureg, controls, targets, "multiControlledMultiQubitUnitary")
     V.validate_unitary(u, len(targets), "multiControlledMultiQubitUnitary")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, len(targets), "multiControlledMultiQubitUnitary")
     _apply_unitary(qureg, u, tuple(targets), tuple(controls))
     qureg.qasm_log.comment("multiControlledMultiQubitUnitary applied")
 

@@ -56,7 +56,7 @@ def calcProbOfOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
 def calcProbOfAllOutcomes(qureg: Qureg, qubits: Sequence[int]) -> np.ndarray:
     """Probabilities of every outcome of a sub-register measurement (QuEST.h:3136)."""
     qubits = [int(q) for q in qubits]
-    V.validate_multi_qubits(qureg, qubits, "calcProbOfAllOutcomes")
+    V.validate_multi_targets(qureg, qubits, "calcProbOfAllOutcomes")
     if qureg.is_density_matrix:
         p = C.calc_prob_of_all_outcomes_density(
             qureg.amps, num_qubits=qureg.num_qubits_represented, qubits=tuple(qubits)
@@ -131,7 +131,7 @@ def mixDephasing(qureg: Qureg, targetQubit: int, prob: float) -> None:
     """One-qubit dephasing channel (QuEST.h:3421)."""
     V.validate_density_matrix(qureg, "mixDephasing")
     V.validate_target(qureg, targetQubit, "mixDephasing")
-    V.validate_prob(prob, "mixDephasing", 0.5, "dephasing probability")
+    V.validate_one_qubit_dephase_prob(prob, "mixDephasing")
     qureg.amps = D.mix_dephasing(
         qureg.amps, prob, num_qubits=qureg.num_qubits_represented, target=targetQubit
     )
@@ -141,7 +141,7 @@ def mixTwoQubitDephasing(qureg: Qureg, qubit1: int, qubit2: int, prob: float) ->
     """Two-qubit dephasing channel (QuEST.h:3453)."""
     V.validate_density_matrix(qureg, "mixTwoQubitDephasing")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDephasing")
-    V.validate_prob(prob, "mixTwoQubitDephasing", 0.75, "two-qubit dephasing probability")
+    V.validate_two_qubit_dephase_prob(prob, "mixTwoQubitDephasing")
     qureg.amps = D.mix_two_qubit_dephasing(
         qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
         qubit1=qubit1, qubit2=qubit2,
@@ -158,7 +158,7 @@ def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
     """One-qubit depolarising channel (QuEST.h:3496)."""
     V.validate_density_matrix(qureg, "mixDepolarising")
     V.validate_target(qureg, targetQubit, "mixDepolarising")
-    V.validate_prob(prob, "mixDepolarising", 0.75, "depolarising probability")
+    V.validate_one_qubit_depol_prob(prob, "mixDepolarising")
     _mix_kraus(qureg, D.depolarising_kraus(prob, qureg.dtype), (targetQubit,))
 
 
@@ -166,7 +166,7 @@ def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
     """One-qubit amplitude damping channel (QuEST.h:3534)."""
     V.validate_density_matrix(qureg, "mixDamping")
     V.validate_target(qureg, targetQubit, "mixDamping")
-    V.validate_prob(prob, "mixDamping", 1.0, "damping probability")
+    V.validate_one_qubit_damping_prob(prob, "mixDamping")
     _mix_kraus(qureg, D.damping_kraus(prob, qureg.dtype), (targetQubit,))
 
 
@@ -174,7 +174,7 @@ def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float)
     """Two-qubit depolarising channel (QuEST.h:3601)."""
     V.validate_density_matrix(qureg, "mixTwoQubitDepolarising")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDepolarising")
-    V.validate_prob(prob, "mixTwoQubitDepolarising", 15.0 / 16, "two-qubit depolarising probability")
+    V.validate_two_qubit_depol_prob(prob, "mixTwoQubitDepolarising")
     _mix_kraus(
         qureg, D.two_qubit_depolarising_kraus(prob, qureg.dtype), (qubit1, qubit2)
     )
@@ -184,10 +184,7 @@ def mixPauli(qureg: Qureg, targetQubit: int, probX: float, probY: float, probZ: 
     """One-qubit Pauli channel with probabilities (pX, pY, pZ) (QuEST.h:3642)."""
     V.validate_density_matrix(qureg, "mixPauli")
     V.validate_target(qureg, targetQubit, "mixPauli")
-    for p, nm in ((probX, "X"), (probY, "Y"), (probZ, "Z")):
-        V.validate_prob(p, "mixPauli", 1.0, f"Pauli-{nm} probability")
-    if probX + probY + probZ > 1 + real_eps():
-        raise V.QuESTError("mixPauli: The probabilities must sum to <= 1.")
+    V.validate_one_qubit_pauli_probs(probX, probY, probZ, "mixPauli")
     _mix_kraus(qureg, D.pauli_kraus(probX, probY, probZ, qureg.dtype), (targetQubit,))
 
 
@@ -223,7 +220,8 @@ def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops, numOps: Opt
     ops = list(ops)[: int(numOps)] if numOps is not None else list(ops)
     targets = [int(t) for t in targets]
     V.validate_density_matrix(qureg, "mixMultiQubitKrausMap")
-    V.validate_multi_qubits(qureg, targets, "mixMultiQubitKrausMap")
+    V.validate_multi_targets(qureg, targets, "mixMultiQubitKrausMap")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, 2 * len(targets), "mixMultiQubitKrausMap")
     V.validate_kraus_ops(ops, len(targets), "mixMultiQubitKrausMap")
     _mix_kraus(qureg, [np.asarray(o, complex) for o in ops], tuple(targets))
 
@@ -301,7 +299,7 @@ def calcPurity(qureg: Qureg) -> float:
 
 def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
     """Fidelity of a register against a pure reference state (QuEST.h:3724)."""
-    V.validate_state_vector(pureState, "calcFidelity")
+    V.validate_second_qureg_state_vec(pureState, "calcFidelity")
     V.validate_matching_qureg_dims(qureg, pureState, "calcFidelity")
     if qureg.is_density_matrix:
         return float(
@@ -333,7 +331,7 @@ def calcExpecPauliProd(qureg: Qureg, targetQubits, pauliCodes, workspace: Option
     """Expected value of a product of Pauli operators (uses workspace) (QuEST.h:4189)."""
     targets = [int(t) for t in targetQubits]
     codes = [int(c) for c in pauliCodes]
-    V.validate_multi_qubits(qureg, targets, "calcExpecPauliProd")
+    V.validate_multi_targets(qureg, targets, "calcExpecPauliProd")
     V.validate_pauli_codes(codes, "calcExpecPauliProd")
     coeffs = np.ones(1)
     flat = _full_codes(qureg, targets, codes)
@@ -356,6 +354,7 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
     codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
     coeffs = np.asarray(termCoeffs, dtype=np.float64)
     num_terms = coeffs.size
+    V.validate_num_pauli_sum_terms(num_terms, "calcExpecPauliSum")
     if len(codes) != num_terms * n:
         raise V.QuESTError("calcExpecPauliSum: Number of Pauli codes doesn't match numSumTerms*numQubits.")
     V.validate_pauli_codes(codes, "calcExpecPauliSum")
@@ -440,7 +439,8 @@ def applyMatrix4(qureg: Qureg, targetQubit1: int, targetQubit2: int, u) -> None:
 def applyMatrixN(qureg: Qureg, targs: Sequence[int], u) -> None:
     """Left-multiply an arbitrary 2^N x 2^N matrix (no unitarity check, no density-matrix twin) (QuEST.h:5260)."""
     targets = [int(t) for t in targs]
-    V.validate_multi_qubits(qureg, targets, "applyMatrixN")
+    V.validate_multi_targets(qureg, targets, "applyMatrixN")
+    V.validate_multi_qubit_matrix_fits_in_node(qureg, len(targets), "applyMatrixN")
     V.validate_matrix_size(u, len(targets), "applyMatrixN")
     _apply_matrix_raw(qureg, u, tuple(targets))
 
@@ -460,6 +460,7 @@ def applyPauliSum(inQureg: Qureg, allPauliCodes, termCoeffs, outQureg: Qureg) ->
     codes = tuple(int(c) for c in np.asarray(allPauliCodes).ravel())
     coeffs = np.asarray(termCoeffs, dtype=np.float64)
     num_terms = coeffs.size
+    V.validate_num_pauli_sum_terms(num_terms, "applyPauliSum")
     if len(codes) != num_terms * n:
         raise V.QuESTError("applyPauliSum: Number of Pauli codes doesn't match numSumTerms*numQubits.")
     V.validate_pauli_codes(codes, "applyPauliSum")
@@ -571,9 +572,12 @@ def applyPhaseFunc(qureg: Qureg, qubits, encoding, coeffs, exponents) -> None:
 def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents, overrideInds, overridePhases) -> None:
     """Single-variable phase function with explicit per-index overrides (QuEST.h:5682)."""
     qubits = [int(q) for q in qubits]
-    V.validate_multi_qubits(qureg, qubits, "applyPhaseFunc")
-    V.validate_bit_encoding(int(encoding), "applyPhaseFunc")
+    V.validate_qubit_subregs(qureg, [qubits], "applyPhaseFunc")
+    V.validate_bit_encoding(int(encoding), "applyPhaseFunc",
+                            num_qubits=len(qubits))
     inds, phases = _norm_overrides(overrideInds, overridePhases, 1)
+    V.validate_phase_func_terms(len(qubits), int(encoding), coeffs, exponents,
+                                [i[0] for i in inds], "applyPhaseFunc")
     V.validate_phase_func_overrides([len(qubits)], int(encoding), inds, "applyPhaseFunc")
     qureg.amps = PF.apply_phase_func(
         qureg.amps, np.asarray(coeffs, np.float64), np.asarray(exponents, np.float64),
@@ -603,9 +607,19 @@ def _split_regs(qubits, numQubitsPerReg):
 def applyMultiVarPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg, overrideInds, overridePhases) -> None:
     """Multi-variable phase function with explicit per-index phase overrides (QuEST.h:5925)."""
     regs = _split_regs(qubits, numQubitsPerReg)
-    for r in regs:
-        V.validate_multi_qubits(qureg, list(r), "applyMultiVarPhaseFunc")
-    V.validate_bit_encoding(int(encoding), "applyMultiVarPhaseFunc")
+    V.validate_qubit_subregs(qureg, [list(r) for r in regs],
+                             "applyMultiVarPhaseFunc")
+    V.validate_multi_reg_bit_encoding([len(r) for r in regs], int(encoding),
+                                      "applyMultiVarPhaseFunc")
+    exps = np.asarray(exponents, np.float64)
+    pos = 0
+    exps_per_reg = []
+    for t in numTermsPerReg:
+        exps_per_reg.append(exps[pos:pos + int(t)])
+        pos += int(t)
+    V.validate_multi_var_phase_func_terms(
+        [len(r) for r in regs], int(encoding), exps_per_reg,
+        "applyMultiVarPhaseFunc")
     inds, phases = _norm_overrides(overrideInds, overridePhases, len(regs))
     V.validate_phase_func_overrides(
         [len(r) for r in regs], int(encoding), inds, "applyMultiVarPhaseFunc"
@@ -644,16 +658,14 @@ def applyParamNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionN
 def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, functionNameCode, params, overrideInds, overridePhases, *, _conj=False) -> None:
     """Parameterised named phase function with per-index overrides (QuEST.h:6326)."""
     regs = _split_regs(qubits, numQubitsPerReg)
-    for r in regs:
-        V.validate_multi_qubits(
-            qureg, [q - (_shift(qureg) if _conj else 0) for q in r], "applyNamedPhaseFunc"
-        )
-    V.validate_bit_encoding(int(encoding), "applyNamedPhaseFunc")
-    V.validate_phase_func_name(int(functionNameCode), "applyNamedPhaseFunc")
-    if int(functionNameCode) in PF._DIST_FUNCS and len(regs) % 2 != 0:
-        raise V.QuESTError(
-            "applyNamedPhaseFunc: Distance phase functions require a even number of sub-registers."
-        )
+    shift = _shift(qureg) if _conj else 0
+    V.validate_qubit_subregs(
+        qureg, [[q - shift for q in r] for r in regs], "applyNamedPhaseFunc")
+    V.validate_multi_reg_bit_encoding([len(r) for r in regs], int(encoding),
+                                      "applyNamedPhaseFunc")
+    num_params = 0 if params is None else int(np.asarray(params).size)
+    V.validate_phase_func_name(int(functionNameCode), len(regs), num_params,
+                               "applyNamedPhaseFunc")
     inds, phases = _norm_overrides(overrideInds, overridePhases, len(regs))
     V.validate_phase_func_overrides(
         [len(r) for r in regs], int(encoding), inds, "applyNamedPhaseFunc"
@@ -675,7 +687,7 @@ def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, 
 def applyQFT(qureg: Qureg, qubits: Sequence[int], numQubits: Optional[int] = None) -> None:
     """Apply the quantum Fourier transform to the given qubits (QuEST.h:6536)."""
     qubits = [int(q) for q in qubits]
-    V.validate_multi_qubits(qureg, qubits, "applyQFT")
+    V.validate_multi_targets(qureg, qubits, "applyQFT")
     _apply_qft(qureg, qubits)
 
 

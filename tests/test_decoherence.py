@@ -143,15 +143,15 @@ def test_mix_multi_qubit_kraus_map(env, rho_pair, targets, num_ops):
 def test_decoherence_validation(env):
     r = qt.createDensityQureg(N, env)
     q = qt.createQureg(N, env)
-    with pytest.raises(qt.QuESTError, match="density matri"):
+    with pytest.raises(qt.QuESTError, match="valid only for density matrices"):
         qt.mixDephasing(q, 0, 0.1)
-    with pytest.raises(qt.QuESTError, match="probability"):
+    with pytest.raises(qt.QuESTError, match="dephase error cannot exceed 1/2"):
         qt.mixDephasing(r, 0, 0.6)  # > 1/2
-    with pytest.raises(qt.QuESTError, match="probability"):
+    with pytest.raises(qt.QuESTError, match="depolarising error cannot exceed 3/4"):
         qt.mixDepolarising(r, 0, 0.8)  # > 3/4
-    with pytest.raises(qt.QuESTError, match="probability"):
+    with pytest.raises(qt.QuESTError, match=r"Probabilities must be in \[0, 1\]"):
         qt.mixDamping(r, 0, 1.2)
-    with pytest.raises(qt.QuESTError, match="CPTP"):
+    with pytest.raises(qt.QuESTError, match="not a completely positive, trace preserving"):
         qt.mixKrausMap(r, 0, [np.eye(2) * 2])
-    with pytest.raises(qt.QuESTError, match="sum"):
+    with pytest.raises(qt.QuESTError, match="cannot exceed the probability of no error"):
         qt.mixPauli(r, 0, 0.5, 0.4, 0.3)

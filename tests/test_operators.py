@@ -307,12 +307,12 @@ def test_apply_qft_subset(env, rng, qubits):
 
 def test_operator_validation(env, rng):
     q = qt.createQureg(N, env)
-    with pytest.raises(qt.QuESTError, match="size"):
+    with pytest.raises(qt.QuESTError, match="matrix size does not match"):
         qt.applyMatrix2(q, 0, np.eye(4))
-    with pytest.raises(qt.QuESTError, match="Trotter order"):
+    with pytest.raises(qt.QuESTError, match="Trotterisation order"):
         hamil = qt.createPauliHamil(N, 1)
         qt.applyTrotterCircuit(q, hamil, 0.1, 3, 1)
-    with pytest.raises(qt.QuESTError, match="encoding"):
+    with pytest.raises(qt.QuESTError, match="Invalid bit encoding"):
         qt.applyPhaseFunc(q, [0, 1], 5, [1.0], [1.0])
 
 

@@ -353,9 +353,9 @@ def test_validation_errors(env):
         qt.hadamard(q, -1)
     with pytest.raises(qt.QuESTError, match="Control qubit cannot equal target"):
         qt.controlledNot(q, 2, 2)
-    with pytest.raises(qt.QuESTError, match="unique"):
+    with pytest.raises(qt.QuESTError, match="The target qubits must be unique"):
         qt.multiQubitNot(q, [1, 1])
-    with pytest.raises(qt.QuESTError, match="not unitary"):
+    with pytest.raises(qt.QuESTError, match="Matrix is not unitary"):
         qt.unitary(q, 0, np.array([[1, 0], [0, 2]]))
-    with pytest.raises(qt.QuESTError, match="Control qubits cannot equal target"):
+    with pytest.raises(qt.QuESTError, match="Control qubits cannot include target qubit"):
         qt.multiControlledUnitary(q, [1, 2], 2, np.eye(2))

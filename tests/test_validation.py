@@ -1,0 +1,308 @@
+"""Input-validation tests asserting the reference's error-message text.
+
+Mirrors the reference suite's SECTION("input validation") discipline:
+every REQUIRE_THROWS_WITH(..., Contains("...")) asserts a substring of the
+message table (QuEST_validation.c:119-197), which quest_tpu reproduces
+verbatim (quest_tpu/validation.py ERROR_MESSAGES).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import validation as V
+
+N = 5
+
+
+def expect(msg_substr):
+    return pytest.raises(qt.QuESTError, match=msg_substr)
+
+
+@pytest.fixture
+def q(env):
+    return qt.createQureg(N, env)
+
+
+@pytest.fixture
+def rho(env):
+    return qt.createDensityQureg(N, env)
+
+
+# ---------------------------------------------------------------------------
+# register creation / indexing
+# ---------------------------------------------------------------------------
+
+
+class TestCreation:
+    def test_create_qureg(self, env):
+        with expect("Invalid number of qubits. Must create >0."):
+            qt.createQureg(0, env)
+        with expect("Invalid number of qubits. Must create >0."):
+            qt.createDensityQureg(-1, env)
+
+    def test_num_ranks(self):
+        with expect("power-of-2 number of node"):
+            V.validate_num_ranks(3)
+
+    def test_distrib_too_small(self):
+        with expect("at least one amplitude per node"):
+            V.validate_num_qubits(1, "createQureg", num_ranks=4)
+
+    def test_amp_index(self, q):
+        with expect("Invalid amplitude index."):
+            qt.getAmp(q, 1 << N)
+        with expect("Invalid amplitude index."):
+            qt.getAmp(q, -1)
+
+    def test_num_amps(self, q):
+        with expect("Invalid number of amplitudes."):
+            qt.setAmps(q, 0, np.zeros(40), np.zeros(40), 40)
+        with expect("More amplitudes given than exist in the statevector"):
+            qt.setAmps(q, 30, np.zeros(4), np.zeros(4), 4)
+
+
+# ---------------------------------------------------------------------------
+# qubit-index / control-target validation
+# ---------------------------------------------------------------------------
+
+
+class TestQubitIndices:
+    def test_target(self, q):
+        for bad in (-1, N):
+            with expect("Invalid target qubit."):
+                qt.pauliX(q, bad)
+
+    def test_control(self, q):
+        with expect("Invalid control qubit."):
+            qt.controlledNot(q, N, 0)
+
+    def test_target_is_control(self, q):
+        with expect("Control qubit cannot equal target qubit."):
+            qt.controlledPhaseShift(q, 1, 1, 0.3)
+
+    def test_target_in_controls(self, q):
+        with expect("Control qubits cannot include target qubit."):
+            qt.multiControlledUnitary(q, [0, 2], 2, np.eye(2))
+
+    def test_control_target_collision(self, q):
+        with expect("Control and target qubits must be disjoint."):
+            qt.multiControlledMultiQubitUnitary(q, [0], [0, 1], np.eye(4))
+
+    def test_targets_not_unique(self, q):
+        with expect("The target qubits must be unique."):
+            qt.multiQubitNot(q, [1, 1])
+
+    def test_controls_not_unique(self, q):
+        with expect("The control qubits should be unique."):
+            qt.multiControlledUnitary(q, [1, 1], 2, np.eye(2))
+
+    def test_qubits_not_unique(self, q):
+        with expect("The qubits must be unique."):
+            qt.multiControlledPhaseFlip(q, [1, 1])
+
+    def test_num_targets(self, q):
+        with expect("Invalid number of target qubits."):
+            qt.multiQubitUnitary(q, [], np.eye(1))
+
+    def test_num_controls(self, q):
+        with expect("Invalid number of control qubits."):
+            qt.multiControlledUnitary(q, list(range(N)), 0, np.eye(2))
+
+    def test_control_bit_states(self, q):
+        with expect("must be a bit sequence"):
+            qt.multiStateControlledUnitary(q, [1, 2], [0, 2], 0, np.eye(2))
+
+
+# ---------------------------------------------------------------------------
+# matrices
+# ---------------------------------------------------------------------------
+
+
+class TestMatrices:
+    def test_non_unitary(self, q):
+        with expect("Matrix is not unitary."):
+            qt.unitary(q, 0, np.array([[1, 0], [0, 2]]))
+        with expect("Matrix is not unitary."):
+            qt.twoQubitUnitary(q, 0, 1, np.eye(4) * 1.5)
+
+    def test_non_unitary_complex_pair(self, q):
+        with expect("Compact matrix formed by given complex numbers is not unitary."):
+            qt.compactUnitary(q, 0, 0.9, 0.9)
+
+    def test_unitary_size(self, q):
+        with expect("The matrix size does not match the number of target qubits."):
+            qt.applyMatrix2(q, 0, np.eye(4))
+        with expect("The matrix size does not match the number of target qubits."):
+            qt.multiQubitUnitary(q, [0, 1], np.eye(8))
+
+    def test_zero_axis_vector(self, q):
+        with expect("Invalid axis vector. Must be non-zero."):
+            qt.rotateAroundAxis(q, 0, 0.5, (0.0, 0.0, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# register kinds, outcomes, probabilities
+# ---------------------------------------------------------------------------
+
+
+class TestKindsAndProbs:
+    def test_statevec_only(self, rho):
+        with expect("Operation valid only for state-vectors."):
+            qt.initStateFromAmps(rho, np.zeros(1 << N), np.zeros(1 << N))
+
+    def test_densmatr_only(self, q):
+        with expect("Operation valid only for density matrices."):
+            qt.mixDephasing(q, 0, 0.1)
+        with expect("Operation valid only for density matrices."):
+            qt.calcPurity(q)
+
+    def test_outcome(self, q):
+        with expect("Invalid measurement outcome -- must be either 0 or 1."):
+            qt.calcProbOfOutcome(q, 0, 2)
+
+    def test_collapse_zero_prob(self, q):
+        qt.initClassicalState(q, 0)   # P(q0 = 1) = 0
+        with expect("Can't collapse to state with zero probability."):
+            qt.collapseToOutcome(q, 0, 1)
+
+    def test_mismatching_dims(self, env, q):
+        other = qt.createQureg(N + 1, env)
+        with expect("Dimensions of the qubit registers don't match."):
+            qt.cloneQureg(other, q)
+
+    def test_mismatching_types(self, env, q, rho):
+        with expect("Registers must both be state-vectors or both be density matrices."):
+            qt.cloneQureg(rho, q)
+
+    def test_second_arg_statevec(self, env, rho):
+        rho2 = qt.createDensityQureg(N, env)
+        with expect("Second argument must be a state-vector."):
+            qt.calcFidelity(rho, rho2)
+
+    def test_prob_range(self, rho):
+        with expect(r"Probabilities must be in \[0, 1\]."):
+            qt.mixDamping(rho, 0, 1.2)
+
+    def test_decoherence_caps(self, rho):
+        with expect("single qubit dephase error cannot exceed 1/2"):
+            qt.mixDephasing(rho, 0, 0.6)
+        with expect("two-qubit qubit dephase error cannot exceed 3/4"):
+            qt.mixTwoQubitDephasing(rho, 0, 1, 0.8)
+        with expect("single qubit depolarising error cannot exceed 3/4"):
+            qt.mixDepolarising(rho, 0, 0.8)
+        with expect("two-qubit depolarising error cannot exceed 15/16"):
+            qt.mixTwoQubitDepolarising(rho, 0, 1, 0.95)
+        with expect("cannot exceed the probability of no error"):
+            qt.mixPauli(rho, 0, 0.3, 0.3, 0.3)
+
+
+# ---------------------------------------------------------------------------
+# Pauli / Kraus / Hamiltonians / Trotter / DiagonalOp
+# ---------------------------------------------------------------------------
+
+
+class TestOperators:
+    def test_pauli_code(self, q, env):
+        workspace = qt.createQureg(N, env)
+        with expect("Invalid Pauli code."):
+            qt.calcExpecPauliProd(q, [0], [7], workspace)
+
+    def test_kraus_counts(self, rho):
+        with expect("At least 1 and at most 4 single qubit Kraus operators"):
+            qt.mixKrausMap(rho, 0, [np.eye(2)] * 5)
+        with expect("At least 1 and at most 16 two-qubit Kraus operators"):
+            qt.mixTwoQubitKrausMap(rho, 0, 1, [np.eye(4)] * 17)
+
+    def test_kraus_cptp(self, rho):
+        with expect("not a completely positive, trace preserving"):
+            qt.mixKrausMap(rho, 0, [np.eye(2) * 2])
+
+    def test_kraus_dims(self, rho):
+        with expect("Every Kraus operator must be of the same number of qubits"):
+            qt.mixKrausMap(rho, 0, [np.eye(4)])
+
+    def test_hamil_params(self, env):
+        with expect("The number of qubits and terms in the PauliHamil must be strictly positive."):
+            qt.createPauliHamil(0, 3)
+
+    def test_hamil_dims(self, q):
+        hamil = qt.createPauliHamil(N + 1, 1)
+        with expect("The PauliHamil must act on the same number of qubits"):
+            qt.applyPauliHamil(q, hamil, qt.createQureg(N, q.env))
+
+    def test_trotter(self, q):
+        hamil = qt.createPauliHamil(N, 1)
+        with expect("The Trotterisation order must be 1, or an even number"):
+            qt.applyTrotterCircuit(q, hamil, 0.1, 3, 1)
+        with expect("The number of Trotter repetitions must be >=1."):
+            qt.applyTrotterCircuit(q, hamil, 0.1, 2, 0)
+
+    def test_diag_op_size(self, q, env):
+        op = qt.createDiagonalOp(N + 1, env)
+        with expect("equal number of qubits as that in the applied diagonal"):
+            qt.applyDiagonalOp(q, op)
+
+    def test_diag_hamil_not_diagonal(self, env):
+        op = qt.createDiagonalOp(3, env)
+        hamil = qt.createPauliHamil(3, 1)
+        qt.initPauliHamil(hamil, [0.5], [[1, 0, 0]])   # an X term
+        with expect("contained operators other than PAULI_Z and PAULI_I"):
+            qt.initDiagonalOpFromPauliHamil(op, hamil)
+
+    def test_num_sum_terms(self, q, env):
+        workspace = qt.createQureg(N, env)
+        with expect("Invalid number of terms in the Pauli sum."):
+            qt.calcExpecPauliSum(q, [], [], workspace)
+
+
+# ---------------------------------------------------------------------------
+# phase functions
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseFuncs:
+    def test_bit_encoding(self, q):
+        with expect("Invalid bit encoding."):
+            qt.applyPhaseFunc(q, [0, 1], 5, [1.0], [1.0])
+
+    def test_twos_complement_single_qubit(self, q):
+        with expect("too few qubits to employ TWOS_COMPLEMENT"):
+            qt.applyPhaseFunc(q, [0], qt.TWOS_COMPLEMENT, [1.0], [1.0])
+
+    def test_num_subregisters(self, q):
+        with expect("Invalid number of qubit subregisters"):
+            qt.applyNamedPhaseFunc(q, [], [], qt.UNSIGNED, qt.NORM)
+
+    def test_phase_func_name_params(self, q):
+        with expect("Invalid number of parameters passed"):
+            qt.applyParamNamedPhaseFunc(
+                q, [0, 1], [1, 1], qt.UNSIGNED, qt.NORM, [1.0])
+
+    def test_distance_needs_even_regs(self, q):
+        with expect("require a strictly even number of sub-registers"):
+            qt.applyNamedPhaseFunc(q, [0], [1], qt.UNSIGNED, qt.DISTANCE)
+
+    def test_negative_exponent_needs_zero_override(self, q):
+        with expect("negative exponent which would diverge at zero"):
+            qt.applyPhaseFunc(q, [0, 1], qt.UNSIGNED, [1.0], [-1.0])
+
+    def test_fractional_exponent_twos_complement(self, q):
+        with expect("fractional exponent"):
+            qt.applyPhaseFunc(q, [0, 1], qt.TWOS_COMPLEMENT, [1.0], [0.5])
+
+    def test_override_index_unsigned(self, q):
+        with expect("Invalid phase function override index, in the UNSIGNED encoding."):
+            qt.applyPhaseFuncOverrides(
+                q, [0, 1], qt.UNSIGNED, [1.0], [1.0], [4], [0.0])
+
+    def test_override_index_twos_complement(self, q):
+        with expect("in the TWOS_COMPLEMENT encoding."):
+            qt.applyPhaseFuncOverrides(
+                q, [0, 1], qt.TWOS_COMPLEMENT, [1.0], [1.0], [2], [0.0])
+
+    def test_multi_var_negative_exponent(self, q):
+        with expect("illegal negative exponent"):
+            qt.applyMultiVarPhaseFunc(
+                q, [0, 1], [1, 1], qt.UNSIGNED, [1.0, 1.0], [-1.0, 1.0],
+                [1, 1])
