@@ -83,7 +83,7 @@ def invalidQuESTInputError(errMsg: str, errFunc: str):
 
 def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
     """Create a state-vector register of numQubits qubits (QuEST.h:529)."""
-    V.validate_num_qubits(numQubits, "createQureg")
+    V.validate_num_qubits(numQubits, "createQureg", num_ranks=env.num_ranks)
     q = Qureg(numQubits, env, is_density_matrix=False)
     q.amps = q.device_put(K.init_zero_state(q.num_amps_total, q.dtype))
     return q
@@ -91,7 +91,7 @@ def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
 
 def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
     """Create a density-matrix register (state-vector of 2N qubits) (QuEST.h:623)."""
-    V.validate_num_qubits(numQubits, "createDensityQureg")
+    V.validate_num_qubits(numQubits, "createDensityQureg", num_ranks=env.num_ranks)
     q = Qureg(numQubits, env, is_density_matrix=True)
     q.amps = q.device_put(
         K.init_classical_density(numQubits, 0, q.dtype)
@@ -235,7 +235,7 @@ def reportPauliHamil(hamil: PauliHamil) -> None:
 
 def createDiagonalOp(numQubits: int, env: _env.QuESTEnv) -> DiagonalOp:
     """Allocate a distributed diagonal operator (QuEST.h:977)."""
-    V.validate_num_qubits(numQubits, "createDiagonalOp")
+    V.validate_num_qubits_in_diag_op(numQubits, env.num_ranks, "createDiagonalOp")
     return DiagonalOp(numQubits, env)
 
 
@@ -266,8 +266,7 @@ def setDiagonalOpElems(op: DiagonalOp, startInd: int, reals, imags, numElems: in
     """Overwrite a contiguous range of diagonal-operator elements (QuEST.h:1185)."""
     reals = np.asarray(reals, dtype=np.float64)[:numElems]
     imags = np.asarray(imags, dtype=np.float64)[:numElems]
-    if startInd < 0 or startInd + numElems > (1 << op.num_qubits):
-        raise V.QuESTError("setDiagonalOpElems: Invalid element indices.")
+    V.validate_num_elems(op, startInd, numElems, "setDiagonalOpElems")
     op.real = op.real.at[startInd:startInd + numElems].set(reals.astype(op.real.dtype))
     op.imag = op.imag.at[startInd:startInd + numElems].set(imags.astype(op.imag.dtype))
 
@@ -341,8 +340,7 @@ def initPlusState(qureg: Qureg) -> None:
 
 def initClassicalState(qureg: Qureg, stateInd: int) -> None:
     """Set the register to a computational basis state (QuEST.h:1431)."""
-    if stateInd < 0 or stateInd >= (1 << qureg.num_qubits_represented):
-        raise V.QuESTError("initClassicalState: Invalid state index.")
+    V.validate_state_index(qureg, stateInd, "initClassicalState")
     if qureg.is_density_matrix:
         qureg.amps = qureg.device_put(
             K.init_classical_density(qureg.num_qubits_represented, stateInd, qureg.dtype)

@@ -364,8 +364,12 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
             qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
         )
     else:
-        val = P.calc_expec_pauli_sum_statevec(
-            qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
+        # scan over the term table: one compiled body regardless of term
+        # count (the unrolled variant took ~100 s to compile at 16x24q)
+        codes_seq = jnp.asarray(
+            np.asarray(codes, np.int32).reshape(num_terms, n))
+        val = P.expec_pauli_sum_scan(
+            qureg.amps, codes_seq, jnp.asarray(cj), num_qubits=n
         )
     return float(val)
 
@@ -482,46 +486,75 @@ def applyPauliHamil(inQureg: Qureg, hamil: PauliHamil, outQureg: Qureg) -> None:
 
 def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float, order: int, reps: int) -> None:
     """Symmetrized Suzuki-Trotter e^{-iHt} (agnostic_applyTrotterCircuit,
-    QuEST_common.c:752-834)."""
+    QuEST_common.c:752-834).
+
+    The whole gate stream runs as ONE lax.scan over a (T, n) Pauli-code
+    table (paulis.trotter_scan): compile cost is a single term body
+    regardless of term count / order / reps, where the unrolled per-term
+    multiRotatePauli stream took minutes to compile at config-5 scale.
+    With QASM recording active the per-term path runs instead so each
+    rotation is logged."""
     V.validate_pauli_hamil(hamil, "applyTrotterCircuit")
     V.validate_hamil_matches_qureg(hamil, qureg, "applyTrotterCircuit")
     V.validate_trotter_params(order, reps, "applyTrotterCircuit")
     if time == 0:
         return
-    # NOTE: deliberately NOT wrapped in fusion.gate_fusion — the per-term
-    # parity phase forces a drain every ~36 rotations, and the drain's
-    # host-side plan materialization costs more than the saved passes
-    # (measured 0.3 s unfused vs 2.9 s fused for a 20q 8-term stream).
+    seq = _trotter_schedule(hamil.num_sum_terms, time, order, reps)
+    if qureg.qasm_log.is_logging:
+        # per-term path so every rotation is QASM-logged.  NOTE:
+        # deliberately NOT wrapped in fusion.gate_fusion — the per-term
+        # parity phase forces a drain every ~36 rotations, and the
+        # drain's host-side plan materialization costs more than the
+        # saved passes (measured 0.3 s unfused vs 2.9 s fused for a 20q
+        # 8-term stream).
+        from .api import multiRotatePauli
+
+        targets = list(range(hamil.num_qubits))
+        for t, fac in seq:
+            multiRotatePauli(qureg, targets,
+                             [int(c) for c in hamil.pauli_codes[t]],
+                             2 * fac * float(hamil.term_coeffs[t]))
+        return
+    t_idx = np.asarray([t for t, _ in seq])
+    facs = np.asarray([f for _, f in seq])
+    codes_seq = np.asarray(hamil.pauli_codes)[t_idx].astype(np.int32)
+    angles = 2.0 * facs * np.asarray(hamil.term_coeffs, np.float64)[t_idx]
+    qureg.amps = P.trotter_scan(
+        qureg.amps, jnp.asarray(codes_seq), jnp.asarray(angles),
+        num_qubits=qureg.num_qubits_in_state_vec,
+        rep_qubits=qureg.num_qubits_represented,
+    )
+
+
+def _trotter_schedule(num_terms: int, time: float, order: int, reps: int):
+    """(term index, time factor) sequence of the symmetrized Suzuki
+    recursion — the same expansion _symmetrized_trotter walks, flattened
+    so the scan can consume it as data."""
+    seq = []
+
+    def exp_hamil(fac, reverse):
+        rng = range(num_terms)
+        for t in (reversed(rng) if reverse else rng):
+            seq.append((t, fac))
+
+    def symm(t, o):
+        if o == 1:
+            exp_hamil(t, False)
+        elif o == 2:
+            exp_hamil(t / 2, False)
+            exp_hamil(t / 2, True)
+        else:
+            p = 1.0 / (4 - 4 ** (1.0 / (o - 1)))
+            lower = o - 2
+            symm(p * t, lower)
+            symm(p * t, lower)
+            symm((1 - 4 * p) * t, lower)
+            symm(p * t, lower)
+            symm(p * t, lower)
+
     for _ in range(reps):
-        _symmetrized_trotter(qureg, hamil, time / reps, order)
-
-
-def _exponentiated_pauli_hamil(qureg, hamil, fac, reverse):
-    from .api import multiRotatePauli
-
-    order = range(hamil.num_sum_terms)
-    if reverse:
-        order = reversed(order)
-    targets = list(range(hamil.num_qubits))
-    for t in order:
-        angle = 2 * fac * float(hamil.term_coeffs[t])
-        multiRotatePauli(qureg, targets, [int(c) for c in hamil.pauli_codes[t]], angle)
-
-
-def _symmetrized_trotter(qureg, hamil, time, order):
-    if order == 1:
-        _exponentiated_pauli_hamil(qureg, hamil, time, False)
-    elif order == 2:
-        _exponentiated_pauli_hamil(qureg, hamil, time / 2, False)
-        _exponentiated_pauli_hamil(qureg, hamil, time / 2, True)
-    else:
-        p = 1.0 / (4 - 4 ** (1.0 / (order - 1)))
-        lower = order - 2
-        _symmetrized_trotter(qureg, hamil, p * time, lower)
-        _symmetrized_trotter(qureg, hamil, p * time, lower)
-        _symmetrized_trotter(qureg, hamil, (1 - 4 * p) * time, lower)
-        _symmetrized_trotter(qureg, hamil, p * time, lower)
-        _symmetrized_trotter(qureg, hamil, p * time, lower)
+        symm(time / reps, order)
+    return seq
 
 
 def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:

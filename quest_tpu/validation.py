@@ -135,6 +135,12 @@ def _warn(code: str, func: str):
                   "of rejecting it)", stacklevel=3)
 
 
+def _warn_replicated(code: str, func: str):
+    warnings.warn(f"{func}: {ERROR_MESSAGES[code]} "
+                  "(quest_tpu replicates such small registers across the "
+                  "mesh instead of rejecting them)", stacklevel=3)
+
+
 # ---------------------------------------------------------------------------
 # Environment / register creation (QuEST_validation.c:331-371)
 # ---------------------------------------------------------------------------
@@ -148,13 +154,16 @@ def validate_num_ranks(num_ranks: int, func: str = "createQuESTEnv"):
 
 def validate_num_qubits(num_qubits: int, func: str, num_ranks: int = 1):
     """validateNumQubitsInQureg (:345-355): >0, fits the index type, and
-    >= 1 amplitude per node."""
+    >= 1 amplitude per node.  The reference REJECTS registers smaller than
+    the node count (its chunked allocation cannot represent them); ours
+    replicates such registers across the mesh instead, so this warns with
+    the reference's message rather than raising."""
     if num_qubits <= 0:
         _raise("E_INVALID_NUM_CREATE_QUBITS", func)
     if num_qubits > 62:
         _raise("E_NUM_AMPS_EXCEED_TYPE", func)
     if (1 << num_qubits) < num_ranks:
-        _raise("E_DISTRIB_QUREG_TOO_SMALL", func)
+        _warn_replicated("E_DISTRIB_QUREG_TOO_SMALL", func)
 
 
 def validate_num_qubits_in_matrix(num_qubits: int, func: str):
@@ -164,11 +173,12 @@ def validate_num_qubits_in_matrix(num_qubits: int, func: str):
 
 
 def validate_num_qubits_in_diag_op(num_qubits: int, num_ranks: int, func: str):
-    """validateNumQubitsInDiagOp (:361-371)."""
+    """validateNumQubitsInDiagOp (:361-371); see validate_num_qubits for
+    why the per-node size check warns instead of raising."""
     if num_qubits <= 0:
         _raise("E_INVALID_NUM_CREATE_QUBITS", func)
     if (1 << num_qubits) < num_ranks:
-        _raise("E_DISTRIB_DIAG_OP_TOO_SMALL", func)
+        _warn_replicated("E_DISTRIB_DIAG_OP_TOO_SMALL", func)
 
 
 # ---------------------------------------------------------------------------

@@ -381,3 +381,54 @@ def test_fused_qft_contiguous_high_subset(rng):
     np.testing.assert_allclose(
         oracle.state_from_qureg(q1), oracle.state_from_qureg(q8), atol=1e-10
     )
+
+
+def test_trotter_scan_matches_per_term_path(env, rng):
+    """The lax.scan Trotter body (paulis.trotter_scan) must reproduce the
+    per-term multiRotatePauli stream exactly (QASM recording forces the
+    per-term path)."""
+    for is_rho in (False, True):
+        for order in (1, 2, 4):
+            terms = 6
+            codes = rng.integers(0, 4, (terms, N))
+            coeffs = rng.standard_normal(terms)
+            h = qt.createPauliHamil(N, terms)
+            qt.initPauliHamil(h, coeffs, codes)
+            make = qt.createDensityQureg if is_rho else qt.createQureg
+            q1, q2 = make(N, env), make(N, env)
+            qt.initDebugState(q1)
+            qt.initDebugState(q2)
+            qt.startRecordingQASM(q1)      # forces the per-term path
+            qt.applyTrotterCircuit(q1, h, 0.37, order, 2)
+            qt.stopRecordingQASM(q1)
+            qt.applyTrotterCircuit(q2, h, 0.37, order, 2)
+            np.testing.assert_allclose(
+                np.asarray(q1.amps), np.asarray(q2.amps), atol=1e-12)
+
+
+def test_trotter_scan_window_branch(env, rng):
+    """14-qubit register: the scan body's windowed _product_layer branch
+    (n >= 14) — the one the 24q config-5 workload exercises — must also
+    match the per-term path, and so must the scan-based expectation."""
+    n, terms = 14, 5
+    codes = rng.integers(0, 4, (terms, n))
+    coeffs = rng.standard_normal(terms)
+    h = qt.createPauliHamil(n, terms)
+    qt.initPauliHamil(h, coeffs, codes)
+    q1, q2 = qt.createQureg(n, env), qt.createQureg(n, env)
+    qt.initPlusState(q1)
+    qt.initPlusState(q2)
+    qt.startRecordingQASM(q1)          # forces the per-term path
+    qt.applyTrotterCircuit(q1, h, 0.23, 2, 1)
+    qt.stopRecordingQASM(q1)
+    qt.applyTrotterCircuit(q2, h, 0.23, 2, 1)
+    np.testing.assert_allclose(
+        np.asarray(q1.amps), np.asarray(q2.amps), atol=1e-12)
+    w = qt.createQureg(n, env)
+    e_scan = qt.calcExpecPauliHamil(q2, h, w)
+    # reference: the unrolled (static-code) expectation path
+    from quest_tpu.ops import paulis as P
+    e_ref = float(P.calc_expec_pauli_sum_statevec(
+        q2.amps, coeffs, num_qubits=n,
+        codes_flat=tuple(int(c) for c in codes.ravel()), num_terms=terms))
+    np.testing.assert_allclose(e_scan, e_ref, atol=1e-10)
