@@ -253,11 +253,7 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
     """Fill a DiagonalOp from real/imag arrays (QuEST.h:1039)."""
     rdt = real_dtype()
     dim = 1 << op.num_qubits
-    sharding = (
-        op.env.vec_sharding()
-        if dim >= op.env.num_devices
-        else op.env.replicated_sharding()
-    )
+    sharding = op.env.sharding_for_dim(dim)
     op.real = jax.device_put(jnp.asarray(np.asarray(reals), rdt), sharding)
     op.imag = jax.device_put(jnp.asarray(np.asarray(imags), rdt), sharding)
 
@@ -273,27 +269,26 @@ def setDiagonalOpElems(op: DiagonalOp, startInd: int, reals, imags, numElems: in
 
 def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
     """Requires an all-I/Z Hamiltonian; diagonal_d = sum_t c_t prod_q
-    (-1)^{z_q(d)} (reference agnostic_initDiagonalOpFromPauliHamil,
-    QuEST_cpu.c:4188-4227)."""
-    V.validate_pauli_hamil(hamil, "initDiagonalOpFromPauliHamil")
-    if op.num_qubits != hamil.num_qubits:
-        raise V.QuESTError(
-            "initDiagonalOpFromPauliHamil: PauliHamil and DiagonalOp dimensions differ."
-        )
-    if np.any((hamil.pauli_codes != PAULI_I) & (hamil.pauli_codes != PAULI_Z)):
-        raise V.QuESTError(
-            "initDiagonalOpFromPauliHamil: The PauliHamil contained operators other than PAULI_Z and PAULI_I."
-        )
+    (-1)^{z_q(d)}, computed ON DEVICE over the sharded index space
+    (reference agnostic_initDiagonalOpFromPauliHamil,
+    QuEST_cpu.c:4188-4227; paulis.diag_from_z_hamil)."""
+    V.validate_diag_pauli_hamil(op, hamil, "initDiagonalOpFromPauliHamil")
+    codes = np.asarray(hamil.pauli_codes)
+    zmasks = np.zeros(hamil.num_sum_terms, np.uint64)
+    for q in range(hamil.num_qubits):
+        zmasks |= ((codes[:, q] == PAULI_Z).astype(np.uint64) << np.uint64(q))
+    lo = (zmasks & np.uint64((1 << 31) - 1)).astype(np.uint32)
+    hi = (zmasks >> np.uint64(31)).astype(np.uint32)
+    rdt = real_dtype()
     dim = 1 << op.num_qubits
-    idx = np.arange(dim, dtype=np.int64)
-    total = np.zeros(dim, dtype=np.float64)
-    for t in range(hamil.num_sum_terms):
-        signs = np.ones(dim, dtype=np.float64)
-        for q in range(hamil.num_qubits):
-            if hamil.pauli_codes[t, q] == PAULI_Z:
-                signs *= 1.0 - 2.0 * ((idx >> q) & 1)
-        total += hamil.term_coeffs[t] * signs
-    initDiagonalOp(op, total, np.zeros_like(total))
+    sharding = op.env.sharding_for_dim(dim)
+    diag = P.diag_from_z_hamil(
+        jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(hamil.term_coeffs, rdt),
+        num_qubits=op.num_qubits, dtype=rdt, sharding=sharding,
+    )
+    op.real = jax.device_put(diag, sharding)
+    op.imag = jax.device_put(jnp.zeros((dim,), rdt), sharding)
 
 
 def createDiagonalOpFromPauliHamilFile(filename: str, env: _env.QuESTEnv) -> DiagonalOp:
@@ -848,8 +843,8 @@ def multiControlledMultiRotatePauli(qureg, controlQubits, targetQubits, targetPa
     )
 
 
-_RY_M90 = (1 / math.sqrt(2)) * np.array([[1, 1], [-1, 1]], dtype=complex)  # Z->X
-_RX_P90 = (1 / math.sqrt(2)) * np.array([[1, -1j], [-1j, 1]], dtype=complex)  # Z->Y
+_RY_M90 = G.RY_M90  # Z->X
+_RX_P90 = G.RX_P90  # Z->Y
 
 
 def _multi_rotate_pauli(qureg, targets, paulis, angle, controls):

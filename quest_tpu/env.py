@@ -50,6 +50,13 @@ class QuESTEnv:
     def replicated_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec())
 
+    def sharding_for_dim(self, dim: int) -> NamedSharding:
+        """Per-amplitude vector sharding when the vector spans the mesh,
+        replicated otherwise (small registers replicate rather than being
+        rejected — see validation.validate_num_qubits)."""
+        return (self.vec_sharding() if dim >= self.num_devices
+                else self.replicated_sharding())
+
 
 def init_distributed(
     coordinator_address: Optional[str] = None,

@@ -26,6 +26,12 @@ Z_DIAG = np.array([1, -1], dtype=np.complex128)
 
 PAULI_MATRICES = (PAULI_I, PAULI_X, PAULI_Y, PAULI_Z)
 
+# Basis rotations taking Z to X / Y (multiRotatePauli decomposition,
+# QuEST_common.c:424-462) — the single source for both the per-term gate
+# path (api._multi_rotate_pauli) and the scan tables (paulis._rot_tables)
+RY_M90 = (1 / np.sqrt(2)) * np.array([[1, 1], [-1, 1]], dtype=np.complex128)
+RX_P90 = (1 / np.sqrt(2)) * np.array([[1, -1j], [-1j, 1]], dtype=np.complex128)
+
 # (reference sqrtSwap matrix, QuEST_common.c:397-421)
 SQRT_SWAP = np.array(
     [

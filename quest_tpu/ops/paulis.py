@@ -146,17 +146,52 @@ def _rot_tables(dt):
     return soa(tab), soa(tabd), soa(np.conj(tab)), soa(np.conj(tabd))
 
 
-def _parity_phase_mask(amps, theta, zmask, n):
+_PAR_LO_BITS = 31  # uint32 iota stays exact up to 2^31 entries
+
+
+def _parity_sign_dynamic(zm_lo, zm_hi, n, dt):
+    """(2^n,)-shaped (+1/-1) sign of parity(idx & zmask) with a TRACED
+    64-bit mask carried as two uint32 halves (bits [0,31) / [31,62)) —
+    parity factorises over the split, so the sign is an outer product of
+    two <=2^31-entry factors and no index arithmetic ever exceeds 32 bits
+    (the reference's isOddParity runs on 64-bit masks,
+    QuEST_cpu_internal.h:38).  Everything fuses; nothing materialises
+    beyond the output sign."""
+    lo = min(n, _PAR_LO_BITS)
+    idx_lo = jax.lax.iota(jnp.uint32, 1 << lo)
+    s_lo = 1.0 - 2.0 * (
+        (jax.lax.population_count(idx_lo & zm_lo) & jnp.uint32(1))
+        .astype(dt))
+    if n <= _PAR_LO_BITS:
+        return s_lo
+    idx_hi = jax.lax.iota(jnp.uint32, 1 << (n - lo))
+    s_hi = 1.0 - 2.0 * (
+        (jax.lax.population_count(idx_hi & zm_hi) & jnp.uint32(1))
+        .astype(dt))
+    return (s_hi[:, None] * s_lo[None, :]).reshape(-1)
+
+
+def _parity_phase_mask(amps, theta, zm_lo, zm_hi, n):
     """exp(-i theta/2 (-1)^parity(idx & zmask)) with a TRACED mask —
     the data-driven variant of kernels.apply_parity_phase (reference
-    multiRotateZ bit-parity trick, QuEST_cpu.c:3268-3317); iota +
-    population_count fuse into the complex multiply, no index arrays
-    materialize."""
-    idx = jax.lax.iota(jnp.uint32, 1 << n)
-    par = jax.lax.population_count(idx & zmask) & jnp.uint32(1)
-    s = 1.0 - 2.0 * par.astype(amps.dtype)
+    multiRotateZ bit-parity trick, QuEST_cpu.c:3268-3317)."""
+    s = _parity_sign_dynamic(zm_lo, zm_hi, n, amps.dtype)
     ang = -0.5 * theta
     return cplx.cmul(amps, jnp.cos(ang), jnp.sin(ang) * s)
+
+
+def _zmask_halves(codes, qbit_offset, nq):
+    """(lo, hi) uint32 halves of sum_q [codes_q != I] << (q + offset)."""
+    zm_lo = jnp.uint32(0)
+    zm_hi = jnp.uint32(0)
+    for q in range(nq):
+        bit = (codes[q] != 0).astype(jnp.uint32)
+        pos = q + qbit_offset
+        if pos < _PAR_LO_BITS:
+            zm_lo = zm_lo | (bit << pos)
+        else:
+            zm_hi = zm_hi | (bit << (pos - _PAR_LO_BITS))
+    return zm_lo, zm_hi
 
 
 def _product_layer(amps, mats, n):
@@ -211,8 +246,6 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
     is_density = n == 2 * nq
     dt = amps.dtype
     tab, tabd, tabc, tabcd = _rot_tables(dt)
-    qbits = jnp.asarray([jnp.uint32(1) << q for q in range(nq)],
-                        jnp.uint32)
 
     def mats_for(codes, t, tc):
         m = t[codes]                        # (nq, 2, 2, 2)
@@ -225,13 +258,14 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
         ang = ang.astype(dt)
         mats = mats_for(codes, tab, tabc)
         carry = _product_layer(carry, mats, n)
-        zm = jnp.sum(jnp.where(codes != 0, qbits, jnp.uint32(0)))
+        zlo, zhi = _zmask_halves(codes, 0, nq)
         # all-identity terms contribute only a global phase the unfused
         # path skips; match it by zeroing the angle
-        theta = jnp.where(zm == 0, jnp.asarray(0.0, dt), ang)
-        carry = _parity_phase_mask(carry, theta, zm, n)
+        theta = jnp.where((zlo | zhi) == 0, jnp.asarray(0.0, dt), ang)
+        carry = _parity_phase_mask(carry, theta, zlo, zhi, n)
         if is_density:
-            carry = _parity_phase_mask(carry, -theta, zm << nq, n)
+            blo, bhi = _zmask_halves(codes, nq, nq)
+            carry = _parity_phase_mask(carry, -theta, blo, bhi, n)
         matsd = mats_for(codes, tabd, tabcd)
         carry = _product_layer(carry, matsd, n)
         return carry, None
@@ -252,18 +286,41 @@ def expec_pauli_sum_scan(amps, codes_seq, coeffs, *, num_qubits: int):
     n = num_qubits
     dt = amps.dtype
     tab, _, _, _ = _rot_tables(dt)
-    qbits = jnp.asarray([jnp.uint32(1) << q for q in range(n)], jnp.uint32)
-    idx = jax.lax.iota(jnp.uint32, 1 << n)
 
     def body(acc, inp):
         codes, coeff = inp
         mats = tab[codes]
         phi = _product_layer(amps, mats, n)
-        zm = jnp.sum(jnp.where(codes != 0, qbits, jnp.uint32(0)))
-        par = jax.lax.population_count(idx & zm) & jnp.uint32(1)
-        s = 1.0 - 2.0 * par.astype(dt)
+        zlo, zhi = _zmask_halves(codes, 0, n)
+        s = _parity_sign_dynamic(zlo, zhi, n, dt)
         val = jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
         return acc + coeff.astype(dt) * val, None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), dt), (codes_seq, coeffs))
     return total
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "dtype", "sharding"))
+def diag_from_z_hamil(zmasks_lo, zmasks_hi, coeffs, *, num_qubits: int,
+                      dtype, sharding=None):
+    """diag_d = sum_t c_t (-1)^parity(d & zmask_t) entirely ON DEVICE —
+    the reference computes this distributed over each node's chunk
+    (agnostic_initDiagonalOpFromPauliHamil, QuEST_cpu.c:4188-4227); the
+    previous host-numpy version materialised a dense 2^n array per term,
+    blowing host memory for exactly the large-n DiagonalOps the type
+    exists for.  Scan over the (T,) z-mask table (uint32 lo/hi halves so
+    n > 31 stays exact): one compiled body, no host arrays beyond the
+    tiny mask/coeff vectors.  ``sharding`` constrains the accumulator so
+    the diagonal is built sharded over the mesh rather than on one
+    device."""
+
+    def body(acc, inp):
+        zlo, zhi, c = inp
+        s = _parity_sign_dynamic(zlo, zhi, num_qubits, acc.dtype)
+        return acc + c.astype(acc.dtype) * s, None
+
+    acc0 = jnp.zeros((1 << num_qubits,), dtype)
+    if sharding is not None:
+        acc0 = jax.lax.with_sharding_constraint(acc0, sharding)
+    acc, _ = jax.lax.scan(body, acc0, (zmasks_lo, zmasks_hi, coeffs))
+    return acc

@@ -107,9 +107,7 @@ class DiagonalOp:
         self.env = env
         rdt = precision.real_dtype()
         dim = 1 << self.num_qubits
-        sharding = (
-            env.vec_sharding() if dim >= env.num_devices else env.replicated_sharding()
-        )
+        sharding = env.sharding_for_dim(dim)
         self.real = jax.device_put(jnp.zeros((dim,), rdt), sharding)
         self.imag = jax.device_put(jnp.zeros((dim,), rdt), sharding)
 

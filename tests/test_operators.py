@@ -432,3 +432,23 @@ def test_trotter_scan_window_branch(env, rng):
         q2.amps, coeffs, num_qubits=n,
         codes_flat=tuple(int(c) for c in codes.ravel()), num_terms=terms))
     np.testing.assert_allclose(e_scan, e_ref, atol=1e-10)
+
+
+def test_parity_sign_split_halves(monkeypatch):
+    """The 64-bit-safe factored parity sign (paulis._parity_sign_dynamic)
+    must match direct popcount parity across the lo/hi split boundary
+    (exercised by shrinking the split so small n crosses it)."""
+    import jax.numpy as jnp
+    from quest_tpu.ops import paulis as P
+
+    monkeypatch.setattr(P, "_PAR_LO_BITS", 3)
+    n = 6
+    rng2 = np.random.default_rng(8)
+    for _ in range(5):
+        mask = int(rng2.integers(0, 1 << n))
+        lo = jnp.uint32(mask & ((1 << 3) - 1))
+        hi = jnp.uint32(mask >> 3)
+        s = np.asarray(P._parity_sign_dynamic(lo, hi, n, jnp.float64))
+        idx = np.arange(1 << n)
+        ref = 1.0 - 2.0 * (np.bitwise_count(idx & mask) & 1)
+        np.testing.assert_array_equal(s, ref)
