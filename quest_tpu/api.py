@@ -112,13 +112,30 @@ def destroyQureg(qureg: Qureg, env: Optional[_env.QuESTEnv] = None) -> None:
 
 
 def reportState(qureg: Qureg) -> None:
-    """Dump amplitudes to state_rank_0.csv (reference reportState,
-    QuEST_common.c:229-245)."""
-    amps = np.asarray(qureg.amps)
-    with open("state_rank_0.csv", "w") as f:
-        f.write("real, imag\n")
-        for re, im in zip(amps[0], amps[1]):
-            f.write(f"{re:.12f}, {im:.12f}\n")
+    """Dump amplitudes to one state_rank_<r>.csv per amplitude chunk — the
+    reference writes one file per MPI rank from that rank's chunk
+    (QuEST_common.c:229-245, header on rank 0 only); here each mesh
+    device's shard plays the chunk role, so no full-state gather to one
+    host buffer ever happens."""
+    amps = qureg.amps
+    chunk = qureg.num_amps_per_chunk
+    shards = sorted(
+        amps.addressable_shards,
+        key=lambda sh: (sh.index[1].start or 0) if len(sh.index) > 1 else 0,
+    )
+    seen = set()
+    for sh in shards:
+        start = (sh.index[1].start or 0) if len(sh.index) > 1 else 0
+        rank = start // chunk if chunk else 0
+        if rank in seen:   # replicated small registers: write once
+            continue
+        seen.add(rank)
+        data = np.asarray(sh.data)
+        with open(f"state_rank_{rank}.csv", "w") as f:
+            if rank == 0:
+                f.write("real, imag\n")
+            for re, im in zip(data[0], data[1]):
+                f.write(f"{re:.12f}, {im:.12f}\n")
 
 
 def reportStateToScreen(qureg: Qureg, env=None, reportRank: int = 0) -> None:
@@ -277,8 +294,9 @@ def initDiagonalOpFromPauliHamil(op: DiagonalOp, hamil: PauliHamil) -> None:
     zmasks = np.zeros(hamil.num_sum_terms, np.uint64)
     for q in range(hamil.num_qubits):
         zmasks |= ((codes[:, q] == PAULI_Z).astype(np.uint64) << np.uint64(q))
-    lo = (zmasks & np.uint64((1 << 31) - 1)).astype(np.uint32)
-    hi = (zmasks >> np.uint64(31)).astype(np.uint32)
+    split = P._PAR_LO_BITS
+    lo = (zmasks & np.uint64((1 << split) - 1)).astype(np.uint32)
+    hi = (zmasks >> np.uint64(split)).astype(np.uint32)
     rdt = real_dtype()
     dim = 1 << op.num_qubits
     sharding = op.env.sharding_for_dim(dim)

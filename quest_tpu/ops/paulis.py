@@ -125,19 +125,16 @@ def apply_pauli_sum(amps, coeffs, out_amps, *, num_qubits: int,
 # Scan-based Trotter body (agnostic_applyTrotterCircuit, QuEST_common.c:752-834)
 # ---------------------------------------------------------------------------
 
-_SQ2 = 0.7071067811865476
-
-
 def _rot_tables(dt):
     """SoA (4, 2, 2, 2) basis-rotation tables indexed by Pauli code:
     I/Z -> identity, X -> Ry(-90) (Z->X), Y -> Rx(+90) (Z->Y); plus the
     dagger and the conjugated (bra-twin) variants."""
     import numpy as np
 
+    from . import gatedefs as G
+
     eye = np.eye(2, dtype=complex)
-    ry = _SQ2 * np.array([[1, 1], [-1, 1]], dtype=complex)
-    rx = _SQ2 * np.array([[1, -1j], [-1j, 1]], dtype=complex)
-    tab = np.stack([eye, ry, rx, eye])
+    tab = np.stack([eye, G.RY_M90, G.RX_P90, eye])
     tabd = np.conj(np.transpose(tab, (0, 2, 1)))
 
     def soa(t):

@@ -63,6 +63,13 @@ class Qureg:
 
     @property
     def amps(self) -> jax.Array:
+        if self._amps is None:
+            from . import validation
+
+            raise validation.QuESTError(
+                "Qureg: the register has been destroyed (destroyQureg) or "
+                "never initialised."
+            )
         if self._fusion is not None and self._fusion.gates:
             from . import fusion
 

@@ -98,3 +98,78 @@ def test_gate_validation(env):
         qt.collapseToOutcome(q, 0, 2)
     with pytest.raises(qt.QuESTError, match="zero probability"):
         qt.collapseToOutcome(q, 0, 1)  # |0...0> has no 1-amplitude
+
+
+@pytest.mark.parametrize("target", [0, 2, 4])
+def test_measure_repeats_density(env, target):
+    """10 repeats per qubit on random density matrices, asserting the
+    post-collapse matrix equals the analytic projection (reference
+    test_gates.cpp density-matrix section)."""
+    rng = np.random.default_rng(53 + target)
+    for rep in range(10):
+        mat = oracle.random_density(N, rng)
+        r = qt.createDensityQureg(N, env)
+        oracle.set_qureg_from_array(qt, r, mat)
+        outcome, prob = qt.measureWithStats(r, target)
+        assert outcome in (0, 1)
+        mask = ((np.arange(DIM) >> target) & 1) == outcome
+        proj = np.diag(mask.astype(float))
+        expect_m = proj @ mat @ proj
+        eprob = np.real(np.trace(expect_m))
+        assert np.isclose(prob, eprob)
+        np.testing.assert_allclose(
+            oracle.state_from_qureg(r), expect_m / eprob, atol=ATOL)
+
+
+def test_measure_statistics_random_state(env):
+    """Outcome frequencies on a fixed random multi-qubit state match the
+    marginal probabilities within sampling tolerance (the distribution
+    itself, not just the post-collapse state)."""
+    qt.seedQuEST(env, [1234])
+    rng = np.random.default_rng(77)
+    n = 3
+    vec = oracle.random_state(n, rng)
+    trials = 300
+    for target in range(n):
+        p1 = float(np.sum(
+            np.abs(vec[((np.arange(1 << n) >> target) & 1) == 1]) ** 2))
+        hits = 0
+        for _ in range(trials):
+            q = qt.createQureg(n, env)
+            oracle.set_qureg_from_array(qt, q, vec)
+            hits += qt.measure(q, target)
+        freq = hits / trials
+        # 3.5 sigma of the binomial
+        tol = 3.5 * np.sqrt(p1 * (1 - p1) / trials) + 1e-9
+        assert abs(freq - p1) < tol, (target, freq, p1, tol)
+
+
+def test_destroyed_qureg_access_raises(env):
+    q = qt.createQureg(N, env)
+    qt.destroyQureg(q, env)
+    with pytest.raises(qt.QuESTError, match="destroyed"):
+        qt.calcTotalProb(q)
+
+
+def test_report_state_per_rank(env, tmp_path, monkeypatch):
+    """reportState writes one CSV per amplitude chunk (per-rank files,
+    reference QuEST_common.c:229-245) instead of gathering to one file."""
+    monkeypatch.chdir(tmp_path)
+    q = qt.createQureg(N, env)
+    qt.initPlusState(q)
+    qt.reportState(q)
+    import glob
+    files = sorted(glob.glob("state_rank_*.csv"))
+    assert files, "no per-rank state files written"
+    rows = 0
+    for fn in files:
+        with open(fn) as f:
+            lines = [ln for ln in f if ln.strip()]
+        if fn.endswith("_0.csv"):
+            assert lines[0].startswith("real")
+            lines = lines[1:]
+        rows += len(lines)
+    assert rows == DIM
+    amp = 1.0 / np.sqrt(DIM)
+    first = open(files[0]).readlines()[1].split(",")
+    assert abs(float(first[0]) - amp) < 1e-9
