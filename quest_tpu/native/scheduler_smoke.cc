@@ -1,0 +1,78 @@
+// ASan smoke driver for the native circuit planner: plans a layered
+// 1q + neighbour-2q circuit through both planners and frees the result
+// buffers.  Built with -fsanitize=address in CI (.github/workflows/
+// native-asan.yml) — the analogue of the reference's llvm-asan.yml run of
+// its kernel suite under AddressSanitizer.
+//
+// Build: g++ -O1 -g -fsanitize=address scheduler.cc scheduler_smoke.cc
+//        -o scheduler_smoke && ./scheduler_smoke
+
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
+             const int64_t* targets, int64_t** out_buf, int64_t* out_len);
+int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
+                      const int64_t* targets, const int64_t* xranks,
+                      int64_t** out_buf, int64_t* out_len);
+void qts_free(int64_t* buf);
+}
+
+static int run(int64_t n, int64_t depth) {
+  std::vector<int64_t> offsets{0};
+  std::vector<int64_t> targets;
+  std::vector<int64_t> xranks;
+  for (int64_t d = 0; d < depth; ++d) {
+    for (int64_t q = 0; q < n; ++q) {
+      targets.push_back(q);
+      offsets.push_back((int64_t)targets.size());
+      xranks.push_back(0);
+    }
+    for (int64_t q = d % 2; q + 1 < n; q += 2) {
+      targets.push_back(q);
+      targets.push_back(q + 1);
+      offsets.push_back((int64_t)targets.size());
+      xranks.push_back(2);
+    }
+  }
+  int64_t num_gates = (int64_t)offsets.size() - 1;
+
+  int64_t* buf = nullptr;
+  int64_t len = 0;
+  int rc = qts_plan(n, num_gates, offsets.data(), targets.data(), &buf, &len);
+  if (rc != 0 || !buf || len <= 0) {
+    std::printf("qts_plan failed rc=%d len=%lld\n", rc, (long long)len);
+    return 1;
+  }
+  qts_free(buf);
+
+  buf = nullptr;
+  len = 0;
+  rc = qts_plan_windowed(n, num_gates, offsets.data(), targets.data(),
+                         xranks.data(), &buf, &len);
+  if (rc != 0 || !buf || len <= 0) {
+    std::printf("qts_plan_windowed failed rc=%d len=%lld\n", rc,
+                (long long)len);
+    return 1;
+  }
+  qts_free(buf);
+  return 0;
+}
+
+int main() {
+  for (int64_t n : {14, 16, 20, 26}) {
+    for (int64_t depth : {1, 4, 10}) {
+      if (run(n, depth) != 0) return 1;
+    }
+  }
+  // error paths must not leak or overrun either
+  int64_t off_bad[2] = {0, 1};
+  int64_t tgt_bad[1] = {99};
+  int64_t* buf = nullptr;
+  int64_t len = 0;
+  if (qts_plan(14, 1, off_bad, tgt_bad, &buf, &len) == 0) return 1;
+  std::puts("scheduler ASan smoke OK");
+  return 0;
+}
