@@ -117,8 +117,15 @@ def reportState(qureg: Qureg) -> None:
     (QuEST_common.c:229-245, header on rank 0 only); here each mesh
     device's shard plays the chunk role, so no full-state gather to one
     host buffer ever happens."""
+    from .parallel import dist as PAR
+
     amps = qureg.amps
-    chunk = qureg.num_amps_per_chunk
+    # chunk = amp-axis shard size (NOT total/num_devices: a multi-axis
+    # (dp, amps) mesh has fewer amplitude shards than devices)
+    env = qureg.env
+    ndev_amp = PAR.amp_axis_size(env.mesh) if env.mesh is not None else 1
+    chunk = (qureg.num_amps_total // ndev_amp
+             if qureg.num_amps_total >= ndev_amp else qureg.num_amps_total)
     shards = sorted(
         amps.addressable_shards,
         key=lambda sh: (sh.index[1].start or 0) if len(sh.index) > 1 else 0,

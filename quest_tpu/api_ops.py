@@ -766,17 +766,19 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     whole swap network (both halves at once for a density matrix), instead
     of the reference's per-layer dispatch (agnostic_applyQFT,
     QuEST_common.c:836-898).  Applies when the targeted qubits are a
-    contiguous ascending run starting at 0 or >= 7, the register is
-    single-device, and the state vector is window-sized; otherwise returns
-    False and the layered path runs."""
+    contiguous ascending run starting at 0 or >= 7 and the state vector is
+    window-sized; otherwise returns False and the layered path runs.
+
+    Sharded registers run the same program under GSPMD: the ladder passes
+    partition on the leading (mesh) bits, layers targeting mesh-coordinate
+    qubits and the final bit-reversal lower to collective-permute /
+    all-to-all over the amplitude axis (collective emission is asserted by
+    tests/test_distributed_hlo.py; correctness vs the dense DFT oracle by
+    tests/test_distributed.py)."""
     from quest_tpu import circuit as CIRC
-    from quest_tpu.parallel import dist as PAR
 
     nsv = _sv_n(qureg)
     if nsv < CIRC.WINDOW:
-        return False
-    env = qureg.env
-    if env.mesh is not None and PAR.amp_axis_size(env.mesh) > 1:
         return False
     nt = len(qubits)
     start = qubits[0]

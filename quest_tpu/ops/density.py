@@ -67,6 +67,9 @@ def mix_dephasing(amps, prob, *, num_qubits: int, target: int):
     n = num_qubits
     nn = 2 * n
     prob = jnp.asarray(prob, amps.dtype)
+    if nn <= 31:
+        sign = kernels.parity_sign_flat(nn, (target, target + n), amps.dtype)
+        return amps * ((1 - prob) + prob * sign)[None]
     sign = kernels.parity_sign_2d(nn, (target, target + n), amps.dtype)
     view = amps.reshape(2, sign.shape[0], sign.shape[1])
     factor = (1 - prob) + prob * sign
@@ -80,6 +83,11 @@ def mix_two_qubit_dephasing(amps, prob, *, num_qubits: int, qubit1: int, qubit2:
     n = num_qubits
     nn = 2 * n
     prob = jnp.asarray(prob, amps.dtype)
+    if nn <= 31:
+        s1 = kernels.parity_sign_flat(nn, (qubit1, qubit1 + n), amps.dtype)
+        s2 = kernels.parity_sign_flat(nn, (qubit2, qubit2 + n), amps.dtype)
+        factor = (1 - prob) + (prob / 3) * (s1 + s2 + s1 * s2)
+        return amps * factor[None]
     s1 = kernels.parity_sign_2d(nn, (qubit1, qubit1 + n), amps.dtype)
     s2 = kernels.parity_sign_2d(nn, (qubit2, qubit2 + n), amps.dtype)
     view = amps.reshape(2, s1.shape[0], s1.shape[1])

@@ -186,6 +186,23 @@ def parity_sign_2d(n: int, qubits, dtype):
     return (1 - 2 * par).astype(dtype)
 
 
+def parity_sign_flat(n: int, qubits, dtype):
+    """(2^n,) sign vector (-1)^parity(bits in ``qubits``) from ONE flat
+    iota.  Under GSPMD a flat iota partitions along the sharded amplitude
+    axis with zero communication, where the factored 2-d outer-product
+    form (parity_sign_2d) made XLA ALL-GATHER the sharded state to align
+    the broadcast (observed: 3 all-gathers per dephasing call on the
+    8-way mesh — tests/test_distributed_hlo.py pins the fixed behavior).
+    int32 iota limits this to n <= 31; callers fall back to the 2-d form
+    beyond that (multi-host scale, where the mask axes are mesh-aligned
+    anyway)."""
+    from ..utils import bits as bits_mod
+
+    assert n <= 31, "flat parity sign needs an int32-safe index space"
+    par = bits_mod.parity_of(jax.lax.iota(jnp.int32, 1 << n), list(qubits))
+    return (1 - 2 * par).astype(dtype)
+
+
 # The lane split: bits 0..6 form the 128-wide minor (lane) block that every
 # layout-safe kernel keeps as the minor axis.  States with n >= _BIG_N take
 # the layout-safe paths; smaller states use the simple einsum/reshape paths
@@ -570,9 +587,14 @@ def apply_parity_phase(
     theta = jnp.asarray(theta, amps.dtype)
 
     def phased(sub, sub_n, sub_qubits):
+        ang = -0.5 * theta
+        if sub_n <= 31:
+            # flat sign: partitions along the sharded amplitude axis with
+            # zero communication (see parity_sign_flat)
+            s = parity_sign_flat(sub_n, sub_qubits, amps.dtype)
+            return cplx.cmul(sub, jnp.cos(ang), jnp.sin(ang) * s)
         s = parity_sign_2d(sub_n, sub_qubits, amps.dtype)
         view = sub.reshape(2, s.shape[0], s.shape[1])
-        ang = -0.5 * theta
         # e^{i ang s} = cos(ang) + i s sin(ang) (cos even, sin odd in s)
         out = cplx.cmul(view, jnp.cos(ang), jnp.sin(ang) * s)
         return out.reshape(2, -1)

@@ -151,3 +151,21 @@ def test_full_circuit_sharded_density(env):
         expect = expect + (0.2 / 3) * PP @ m2 @ PP
     np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
     assert np.isclose(qt.calcTotalProb(r), 1.0)
+
+
+def test_fused_qft_sharded_matches_dft(env):
+    """The fused QFT on an 8-way-sharded register must equal the dense DFT
+    oracle (the sharded path runs the same ladder/reversal program under
+    GSPMD — collectives audited in test_distributed_hlo.py)."""
+    n = 14
+    q = qt.createQureg(n, env)
+    rng = np.random.default_rng(61)
+    vec = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    vec /= np.linalg.norm(vec)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    qt.applyFullQFT(q)
+    got = np.asarray(q.amps[0]) + 1j * np.asarray(q.amps[1])
+    k = np.arange(1 << n)
+    ref = np.exp(2j * np.pi * np.outer(k, k) / (1 << n)) @ vec
+    ref /= np.sqrt(1 << n)
+    np.testing.assert_allclose(got, ref, atol=1e-10)

@@ -1,0 +1,207 @@
+"""Collective-emission audits: what XLA actually compiles per op family.
+
+SURVEY.md §7.5 calls for benchmarking/verifying the explicit ppermute
+layer against GSPMD propagation; VERDICT r1 item 4 asks for "a test that
+counts/asserts the collectives in the compiled program per op family".
+These tests lower each family against 8-way-sharded avals on the virtual
+CPU mesh and assert which communication primitives appear:
+
+- elementwise families (dephasing, DiagonalOp apply, phase functions,
+  parity phases) must compile to ZERO collectives — their masks derive
+  from the global index, which GSPMD computes per-shard (the reference's
+  "no pairing" phase kernels, QuEST_cpu.c:3146-3361, have the same
+  property: no MPI exchange);
+- reductions must emit all-reduce (the reference's MPI_Allreduce,
+  QuEST_cpu_distributed.c:35-117);
+- the explicit distributed layer's sharded-target gates must emit
+  collective-permute (the reference's pairwise MPI_Sendrecv, :489-517);
+- amplitude-pair families on mesh-coordinate bits (depolarising,
+  damping, the fused QFT's high ladders + bit reversal) must emit SOME
+  collective (permute / all-to-all / all-gather), and the elementwise
+  ones must not regress into them.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.ops import density as D
+from quest_tpu.ops import kernels as K
+from quest_tpu.ops import phasefunc as PF
+from quest_tpu.parallel import dist as PAR
+
+COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|collective-permute|all-gather|all-to-all|"
+    r"reduce-scatter)\b")
+
+
+@pytest.fixture(scope="module")
+def env8():
+    e = qt.createQuESTEnv()
+    if e.num_ranks < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    return e
+
+
+def collectives(fn, *args, env=None, donate=False):
+    """Compile fn against sharded args and histogram the collective ops in
+    the optimized HLO."""
+    jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+    txt = jfn.lower(*args).compile().as_text()
+    hist = {}
+    for m in COLLECTIVE_RE.finditer(txt):
+        hist[m.group(1)] = hist.get(m.group(1), 0) + 1
+    return hist
+
+
+def sharded_state(env, n, seed=0):
+    rng = np.random.default_rng(seed)
+    amps = rng.standard_normal((2, 1 << n))
+    amps /= np.sqrt((amps ** 2).sum())
+    return jax.device_put(jnp.asarray(amps), env.amp_sharding())
+
+
+class TestElementwiseFamiliesNoComm:
+    """Index-derived elementwise ops must partition with zero collectives."""
+
+    def test_dephasing_density(self, env8):
+        nq = 7                       # rho -> 14 sv qubits, 3 sharded
+        amps = sharded_state(env8, 2 * nq, 1)
+
+        def f(a):
+            return D.mix_dephasing(a, 0.3, num_qubits=nq, target=nq - 1)
+
+        assert collectives(f, amps) == {}
+
+    def test_two_qubit_dephasing_density(self, env8):
+        nq = 7
+        amps = sharded_state(env8, 2 * nq, 2)
+
+        def f(a):
+            return D.mix_two_qubit_dephasing(
+                a, 0.3, num_qubits=nq, qubit1=0, qubit2=nq - 1)
+
+        assert collectives(f, amps) == {}
+
+    def test_diagonal_op_apply(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 3)
+        op = jax.device_put(jnp.ones((1 << n,), amps.dtype),
+                            env8.vec_sharding())
+
+        def f(a):
+            return K.apply_full_diagonal(a, op, op * 0.5)
+
+        assert collectives(f, amps) == {}
+
+    def test_phase_func(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 4)
+
+        def f(a):
+            return PF.apply_phase_func(
+                a, np.asarray([0.5]), np.asarray([2.0]),
+                np.zeros((0, 1), np.int64), np.zeros((0,), np.float64),
+                num_qubits=n, qubits=tuple(range(6)), encoding=0)
+
+        assert collectives(f, amps) == {}
+
+    def test_parity_phase(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 5)
+
+        def f(a):
+            # parity phase across local AND mesh-coordinate bits
+            return K.apply_parity_phase(a, 0.7, num_qubits=n,
+                                        qubits=(0, n - 1))
+
+        assert collectives(f, amps) == {}
+
+
+class TestReductionsAllReduce:
+    def test_total_prob_explicit(self, env8):
+        amps = sharded_state(env8, 14, 6)
+
+        def f(a):
+            return PAR.total_prob_sharded(a, mesh=env8.mesh)
+
+        hist = collectives(f, amps)
+        assert hist.get("all-reduce", 0) >= 1, hist
+
+    def test_expec_diagonal(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 7)
+        op = jax.device_put(jnp.ones((1 << n,), amps.dtype),
+                            env8.vec_sharding())
+
+        def f(a):
+            from quest_tpu.ops import calculations as C
+            return C.calc_expec_diagonal_statevec(a, op, op * 0.0)
+
+        hist = collectives(f, amps)
+        assert hist.get("all-reduce", 0) >= 1, hist
+
+
+class TestExplicitDistLayer:
+    def test_sharded_target_gate_permutes(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 8)
+        h = (1 / np.sqrt(2)) * np.array([[1, 1], [1, -1]])
+        m = jnp.asarray(np.stack([h, np.zeros((2, 2))]))
+
+        def f(a):
+            return PAR.apply_matrix_1q_sharded(
+                a, m, mesh=env8.mesh, num_qubits=n, target=n - 1)
+
+        hist = collectives(f, amps)
+        assert hist.get("collective-permute", 0) >= 1, hist
+
+    def test_swap_sharded_permutes(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 9)
+
+        def f(a):
+            return PAR.swap_sharded(a, mesh=env8.mesh, num_qubits=n,
+                                    qb_low=0, qb_high=n - 1)
+
+        hist = collectives(f, amps)
+        assert hist.get("collective-permute", 0) >= 1, hist
+
+
+class TestPairFamiliesCommunicate:
+    def test_depolarising_sharded_bra_ket(self, env8):
+        # target whose bra twin lands on a mesh-coordinate bit: the
+        # ket<->bra pair average cannot be shard-local
+        nq = 7
+        amps = sharded_state(env8, 2 * nq, 10)
+        ops = D.depolarising_kraus(0.3, amps.dtype)
+
+        def f(a):
+            return D.apply_kraus_map(a, ops, num_qubits=nq,
+                                     targets=(nq - 1,))
+
+        hist = collectives(f, amps)
+        assert hist, "expected at least one collective for the pair average"
+
+    def test_fused_qft_sharded(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 11)
+
+        def f(a):
+            return CIRC.fused_qft(a, n, 0, n)
+
+        hist = collectives(f, amps)
+        assert hist, "expected collectives for mesh-bit ladders + reversal"
+        # ... but the low (shard-local) ladder layers must not have turned
+        # the whole program into per-layer reshuffles: the collective
+        # count stays bounded by ~2 per mesh-bit layer + the reversal
+        r = PAR.num_shard_bits(env8.mesh)
+        total = sum(hist.values())
+        assert total <= 4 * r + 6, hist
