@@ -773,12 +773,24 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     partition on the leading (mesh) bits, layers targeting mesh-coordinate
     qubits and the final bit-reversal lower to collective-permute /
     all-to-all over the amplitude axis (collective emission is asserted by
-    tests/test_distributed_hlo.py; correctness vs the dense DFT oracle by
-    tests/test_distributed.py)."""
+    tests/test_distributed_hlo.py; correctness vs the DFT oracle by
+    tests/test_distributed.py).  EXCEPT on a real multi-chip TPU mesh:
+    there the winfused ops would put a raw pallas_call under GSPMD, which
+    has no partitioning rule (the CPU mesh runs the kernel bodies in
+    interpret mode, which partitions as plain XLA ops) — those registers
+    take the layered path until a shard_map-wrapped drain covers QFT."""
+    import jax as _jax
+
     from quest_tpu import circuit as CIRC
+    from quest_tpu.parallel import dist as PAR
 
     nsv = _sv_n(qureg)
     if nsv < CIRC.WINDOW:
+        return False
+    env = qureg.env
+    if (_jax.default_backend() == "tpu" and env.mesh is not None
+            and PAR.amp_axis_size(env.mesh) > 1
+            and qureg.num_amps_total >= env.num_devices):
         return False
     nt = len(qubits)
     start = qubits[0]

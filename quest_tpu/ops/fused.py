@@ -51,6 +51,17 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _resolve_interpret(interpret, amps) -> bool:
+    """Pallas only on real TPU AND a Mosaic-supported dtype: f64 dots raise
+    NotImplementedError in the Mosaic lowering, so double-precision states
+    (set_precision(2), the reference's default qreal) run the same kernel
+    bodies in interpret mode — plain XLA ops, which the TPU executes via
+    its software-f64 path."""
+    if interpret is not None:
+        return interpret
+    return _interpret_default() or amps.dtype == jnp.float64
+
+
 # MXU contraction precision for the cluster/window matmuls.  f32 inputs on
 # TPU decompose into bf16 MXU passes: HIGHEST = 6 passes (full f32
 # accuracy), DEFAULT = 1 pass (bf16, ~1e-3 — too coarse for amplitudes).
@@ -246,8 +257,7 @@ def _apply_swap_cluster_stack_jit(
     operator sum_r B_r (x) A_r, in ONE HBM pass (see _cluster_swap_kernel).
     Requires h >= 14, 7 <= b and b + m <= 14, m <= MAX_FUSED_SWAP_M."""
     n = num_qubits
-    if interpret is None:
-        interpret = _interpret_default()
+    interpret = _resolve_interpret(interpret, amps)
     rank = mats_a.shape[0]
     M = 1 << m
     nb = 1 << (n - CLUSTER_QUBITS)
@@ -356,8 +366,7 @@ def _apply_window_stack_jit(
     n = num_qubits
     if not (LANE_QUBITS <= k <= n - SUBLANE_QUBITS):
         raise ValueError(f"window offset {k} out of range for n={n}")
-    if interpret is None:
-        interpret = _interpret_default()
+    interpret = _resolve_interpret(interpret, amps)
     rank = mats_a.shape[0]
     hi = 1 << (n - k - SUBLANE_QUBITS)
     mid = 1 << (k - LANE_QUBITS)
@@ -457,8 +466,7 @@ def _apply_cluster_stack_jit(
     n = num_qubits
     if n < CLUSTER_QUBITS:
         raise ValueError(f"apply_cluster_stack needs >= {CLUSTER_QUBITS} qubits")
-    if interpret is None:
-        interpret = _interpret_default()
+    interpret = _resolve_interpret(interpret, amps)
     rank = mats_a.shape[0]
     nb = 1 << (n - CLUSTER_QUBITS)
     r = min(block_rows, nb)

@@ -1,0 +1,102 @@
+"""Single-chip scaling runs: config-2 shape and full QFT at 28-30q.
+
+One program per size (no K-diff double compile: at these sizes compile
+dominates the session budget); device time estimated as wall minus the
+measured scalar-fetch overhead, both reported.  Results recorded in
+BASELINE.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import quest_tpu as qt
+from quest_tpu import circuit as C
+from quest_tpu.models import circuits
+from quest_tpu.ops import calculations, kernels
+
+
+def fetch_overhead():
+    s = jnp.float32(1.0)
+    f = jax.jit(lambda x: x + 1)
+    float(f(s))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(f(s))
+    return (time.perf_counter() - t0) / 5
+
+
+def run_random(n, depth=20):
+    cnot = np.zeros((2, 4, 4), np.float32)
+    cnot[0] = np.array(
+        [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], np.float32)
+    fn, us = circuits.build_random_circuit(n, depth, seed=7)
+
+    def build_gates(us):
+        gates = []
+        for d in range(depth):
+            for q in range(n):
+                gates.append(C.Gate((q,), us[d, q]))
+            for q in range(d % 2, n - 1, 2):
+                gates.append(C.Gate((q, q + 1), cnot))
+        return gates
+
+    @jax.jit
+    def prog(amps, us):
+        amps = C.apply_circuit(amps, build_gates(us), n)
+        return calculations.calc_prob_of_outcome_statevec(
+            amps, num_qubits=n, target=n - 1, outcome=0)
+
+    a = jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+    t0 = time.perf_counter()
+    p = float(prog(a, us))
+    compile_s = time.perf_counter() - t0
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p = float(prog(a, us))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return {"workload": f"{n}q depth-{depth} random", "compile_s": round(compile_s, 1),
+            "wall_s": round(best, 3), "prob": p}
+
+
+def run_qft(n):
+    @jax.jit
+    def prog(amps):
+        amps = C.fused_qft(amps, n, 0, n)
+        return amps[0, 0]
+
+    a = jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+    t0 = time.perf_counter()
+    float(prog(a))
+    compile_s = time.perf_counter() - t0
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(prog(a))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return {"workload": f"{n}q full QFT", "compile_s": round(compile_s, 1),
+            "wall_s": round(best, 3)}
+
+
+if __name__ == "__main__":
+    ov = fetch_overhead()
+    print(json.dumps({"fetch_overhead_s": round(ov, 3)}), flush=True)
+    for arg in sys.argv[1:]:
+        kind, n = arg.split(":")
+        try:
+            r = run_random(int(n)) if kind == "rand" else run_qft(int(n))
+            r["device_s_est"] = round(r["wall_s"] - ov, 3)
+            print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(json.dumps({"workload": arg, "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
