@@ -707,22 +707,16 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
         )
     if planner == "windowed":
         if use_native is None:
-            # The C++ windowed planner has no mask model (diagonal crossing
-            # gates as rank-free elementwise masks); prefer the Python
-            # planner whenever masks could apply — its plans execute 2-4x
-            # faster on TPU (rank-4 pass 18.6 ms vs rank-1+mask ~4.6 ms).
-            use_native = native.native_available() and not any(
-                len(g.targets) == 2
-                and (diag4_2q(g.mat) is not None
-                     or controlled_form_2q(g.mat) is not None)
-                for g in gates
-            )
-        if use_native:
+            use_native = native.native_available()
+        if use_native and num_qubits >= WINDOW:
+            # the controlled-form rewrite happens here so the C++ planner
+            # sees the same (rewritten) gate stream as the Python one
+            glist = rewrite_controlled_gates(list(gates))
             structural = native.plan_native_windowed(
-                [g.targets for g in gates], num_qubits,
-                _gate_xranks(gates))
+                [g.targets for g in glist], num_qubits,
+                _gate_xranks(glist), _gate_flags(glist))
             if structural is not None:
-                return materialize_windowed_plan(structural, gates)
+                return materialize_windowed_plan(structural, glist)
         return plan_circuit_windowed(gates, num_qubits)
     if use_native is None:
         use_native = native.native_available()
@@ -731,6 +725,21 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
         if structural is not None:
             return _peephole(materialize_plan(structural, gates), num_qubits)
     return plan_circuit_py(gates, num_qubits)
+
+
+def _gate_flags(gates: Sequence[Gate]) -> List[int]:
+    """Per-gate diagonality flags for the native planner: bit 0 = diagonal
+    matrix (commutes with a pass mask), bit 1 = concrete diagonal 2q
+    (mask-foldable when crossing lane x window)."""
+    out = []
+    for g in gates:
+        f = 0
+        if is_diag_gate(g.mat):
+            f |= 1
+        if len(g.targets) == 2 and diag4_2q(g.mat) is not None:
+            f |= 2
+        out.append(f)
+    return out
 
 
 def _gate_xranks(gates: Sequence[Gate]) -> List[int]:
@@ -759,14 +768,18 @@ def materialize_windowed_plan(structural: Sequence[tuple],
             k, entries = op[1], op[2]
             acc = _WinAcc(k)
             for kind, gi, bits in entries:
-                if kind == 2:
+                if kind == 3:
+                    acc.fold_mask(bits[0], bits[1], diag4_2q(gates[gi].mat),
+                                  bool(bits[2]))
+                elif kind == 2:
                     acc.fold_cross(bits[0], bits[1], gates[gi].mat,
                                    bool(bits[2]))
                 else:
                     acc.fold_side("A" if kind == 0 else "B", tuple(bits),
                                   gates[gi].mat)
             a, b = acc.stacks()
-            ops.append(("winfused", k, a, b, acc.a_used, acc.b_used))
+            ops.append(("winfused", k, a, b, acc.a_used, acc.b_used,
+                        acc.mask_soa()))
         elif op[0] == "apply":
             ops.append(("apply", op[2], gates[op[1]].mat))
         else:

@@ -81,6 +81,7 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
                 ctypes.POINTER(ctypes.c_int64),
             ]
@@ -96,11 +97,15 @@ def native_available() -> bool:
 
 def plan_native_windowed(target_lists: Sequence[Sequence[int]],
                          num_qubits: int,
-                         xranks: Sequence[int]) -> Optional[List[tuple]]:
+                         xranks: Sequence[int],
+                         flags: Optional[Sequence[int]] = None,
+                         ) -> Optional[List[tuple]]:
     """Run the C++ windowed planner (qts_plan_windowed) over gate target
-    lists + per-gate cross ranks.  Returns a structural plan —
+    lists + per-gate cross ranks and diagonality flags (bit 0 = diagonal
+    matrix, bit 1 = diagonal 2q, mask-foldable when crossing).  Returns a
+    structural plan —
       ('winfused', k, [(kind, gate_idx, bits), ...])  kind: 0=A, 1=B,
-        2=cross with bits=(lane_bit, win_bit, lane_is_bit0)
+        2=cross, 3=mask, both with bits=(lane_bit, win_bit, lane_is_bit0)
       ('apply', gate_idx, targets)
     — or None when the native library (or entry point) is unavailable."""
     lib = get_lib()
@@ -118,6 +123,11 @@ def plan_native_windowed(target_lists: Sequence[Sequence[int]],
     xr = np.asarray(list(xranks), dtype=np.int64)
     if xr.size == 0:
         xr = np.zeros(1, dtype=np.int64)
+    if flags is None:
+        flags = [0] * len(target_lists)
+    fl = np.asarray(list(flags), dtype=np.int64)
+    if fl.size == 0:
+        fl = np.zeros(1, dtype=np.int64)
     buf = ctypes.POINTER(ctypes.c_int64)()
     length = ctypes.c_int64()
     rc = lib.qts_plan_windowed(
@@ -125,6 +135,7 @@ def plan_native_windowed(target_lists: Sequence[Sequence[int]],
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         xr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        fl.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         ctypes.byref(buf), ctypes.byref(length),
     )
     if rc != 0:

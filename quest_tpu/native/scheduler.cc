@@ -377,8 +377,16 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
 //                      {side, gate_idx, nbits, bits[nbits]} * nEntries
 //                      side 0 = lane A (bits = targets), 1 = window B
 //                      (bits = window-relative targets), 2 = cross
+//                      (bits = lane_bit, win_bit, lane_is_bit0),
+//                      3 = MASK fold of a diagonal crossing gate
 //                      (bits = lane_bit, win_bit, lane_is_bit0)
 //   kind 1 (apply):    1, gate_idx, nt, targets[nt]
+//
+// flags[] per gate: bit 0 = gate matrix is diagonal (commutes with a
+// pass's diagonal mask), bit 1 = concrete diagonal 2q (mask-foldable when
+// it straddles lane x window).  Mirrors circuit.plan_circuit_windowed's
+// gdiag/gdiag4 (the controlled-form REWRITE happens Python-side before
+// planning).
 // ---------------------------------------------------------------------------
 
 namespace {
@@ -391,6 +399,7 @@ extern "C" {
 
 int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
                       const int64_t* targets, const int64_t* xranks,
+                      const int64_t* flags,
                       int64_t** out_buf, int64_t* out_len) {
   if (n <= 0 || num_gates < 0 || !offsets || !out_buf || !out_len) return 1;
   for (int64_t i = 0; i < offsets[num_gates]; ++i)
@@ -477,12 +486,25 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
       return {-1, 0, 0, 0};
     };
 
-    // transitive fold closure for window k over copies of the DAG state
+    auto tmask_of = [&](int64_t g) {
+      uint64_t m = 0;
+      for (int64_t i = offsets[g]; i < offsets[g + 1]; ++i)
+        m |= (uint64_t)1 << targets[i];
+      return m;
+    };
+    auto is_diag = [&](int64_t g) { return (flags[g] & 1) != 0; };
+    auto is_diag4 = [&](int64_t g) { return (flags[g] & 2) != 0; };
+
+    // transitive fold closure for window k over copies of the DAG state;
+    // mirrors the Python mask rules: a diagonal crossing gate folds into
+    // the pass mask (rank-free); once the mask is set, only gates
+    // commuting with it (disjoint bits or diagonal) may keep folding
     auto simulate = [&](int64_t k, std::vector<int64_t>& folds_out,
                         int64_t& rank_out) -> int64_t {
       std::vector<int64_t> hd = heads;
       std::vector<int64_t> rdy = ready;
       int64_t rank = 1, count = 0;
+      uint64_t mask_bits = 0;
       bool progressed = true;
       while (progressed) {
         progressed = false;
@@ -491,10 +513,18 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
           if (std::find(rdy.begin(), rdy.end(), g) == rdy.end()) continue;
           Cls c = classify(g, k);
           if (c.kind < 0) continue;
+          bool blocked = mask_bits && !is_diag(g) && (mask_bits & tmask_of(g));
           if (c.kind == 2) {
-            int64_t r = xranks[g];
-            if (rank * r > kRankCap) continue;
-            rank *= r;
+            if (is_diag4(g)) {
+              mask_bits |= tmask_of(g);
+            } else {
+              if (blocked) continue;
+              int64_t r = xranks[g];
+              if (rank * r > kRankCap) continue;
+              rank *= r;
+            }
+          } else if (blocked) {
+            continue;
           }
           ++count;
           folds_out.push_back(g);
@@ -555,7 +585,8 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
       buf.push_back((int64_t)bfolds.size());
       for (int64_t g : bfolds) {
         Cls c = classify(g, bk);
-        buf.push_back(c.kind);
+        int64_t kind = (c.kind == 2 && is_diag4(g)) ? 3 : c.kind;
+        buf.push_back(kind);
         buf.push_back(g);
         if (c.kind == 2) {
           buf.push_back(3);

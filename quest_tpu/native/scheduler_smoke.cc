@@ -16,6 +16,7 @@ int qts_plan(int64_t n, int64_t num_gates, const int64_t* offsets,
              const int64_t* targets, int64_t** out_buf, int64_t* out_len);
 int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
                       const int64_t* targets, const int64_t* xranks,
+                      const int64_t* flags,
                       int64_t** out_buf, int64_t* out_len);
 void qts_free(int64_t* buf);
 }
@@ -50,8 +51,9 @@ static int run(int64_t n, int64_t depth) {
 
   buf = nullptr;
   len = 0;
+  std::vector<int64_t> flags(xranks.size(), 0);
   rc = qts_plan_windowed(n, num_gates, offsets.data(), targets.data(),
-                         xranks.data(), &buf, &len);
+                         xranks.data(), flags.data(), &buf, &len);
   if (rc != 0 || !buf || len <= 0) {
     std::printf("qts_plan_windowed failed rc=%d len=%lld\n", rc,
                 (long long)len);

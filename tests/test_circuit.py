@@ -442,9 +442,9 @@ class TestNativeWindowedScheduler:
 
     @pytest.mark.parametrize("n,depth", [(14, 2), (16, 3), (20, 2)])
     def test_plans_match_python(self, n, depth):
-        # generic dense 2q gates only: controlled-form/diagonal gates take
-        # the Python planner's mask path, which the C++ planner does not
-        # model (plan_circuit prefers Python for those circuits)
+        # generic dense 2q gates only, so no masks appear in these plans
+        # (mask-circuit parity is covered by
+        # test_plans_match_python_with_masks)
         rng = np.random.default_rng(400 + n)
         gates = []
         for d in range(depth):
@@ -474,6 +474,34 @@ class TestNativeWindowedScheduler:
                 assert len(a) < 7 or a[6] is None   # no mask on these plans
             else:
                 assert tuple(a[1]) == tuple(b[1])
+
+    @pytest.mark.parametrize("n,depth", [(14, 3), (18, 2)])
+    def test_plans_match_python_with_masks(self, n, depth):
+        # CNOT ladders: the controlled-form rewrite + mask folds must agree
+        # between the C++ planner (flags path) and the Python planner
+        rng = np.random.default_rng(500 + n)
+        gates = _layered_circuit(rng, n, depth)
+        py = C.plan_circuit_windowed(gates, n)
+        glist = C.rewrite_controlled_gates(gates)
+        structural = native.plan_native_windowed(
+            [g.targets for g in glist], n,
+            C._gate_xranks(glist), C._gate_flags(glist))
+        assert structural is not None, "native windowed planner unavailable"
+        nat = C.materialize_windowed_plan(structural, glist)
+        assert [o[0] for o in py] == [o[0] for o in nat]
+        for a, b in zip(py, nat):
+            if a[0] != "winfused":
+                continue
+            assert a[1] == b[1]
+            np.testing.assert_allclose(np.asarray(a[2]), np.asarray(b[2]),
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(a[3]), np.asarray(b[3]),
+                                       atol=1e-6)
+            assert a[4:6] == b[4:6]
+            ma, mb = a[6], b[6]
+            assert (ma is None) == (mb is None)
+            if ma is not None:
+                np.testing.assert_allclose(ma, mb, atol=1e-12)
 
     def test_native_windowed_e2e(self):
         rng = np.random.default_rng(41)
