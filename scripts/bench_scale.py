@@ -15,6 +15,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+from functools import partial
 import numpy as np
 
 import quest_tpu as qt
@@ -48,18 +49,21 @@ def run_random(n, depth=20):
                 gates.append(C.Gate((q, q + 1), cnot))
         return gates
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=0)
     def prog(amps, us):
         amps = C.apply_circuit(amps, build_gates(us), n)
         return calculations.calc_prob_of_outcome_statevec(
             amps, num_qubits=n, target=n - 1, outcome=0)
 
-    a = jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+    def fresh():
+        return jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+
     t0 = time.perf_counter()
-    p = float(prog(a, us))
+    p = float(prog(fresh(), us))
     compile_s = time.perf_counter() - t0
     best = None
     for _ in range(3):
+        a = fresh()
         t0 = time.perf_counter()
         p = float(prog(a, us))
         dt = time.perf_counter() - t0
@@ -69,17 +73,20 @@ def run_random(n, depth=20):
 
 
 def run_qft(n):
-    @jax.jit
+    @partial(jax.jit, donate_argnums=0)
     def prog(amps):
         amps = C.fused_qft(amps, n, 0, n)
         return amps[0, 0]
 
-    a = jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+    def fresh():
+        return jnp.asarray(kernels.init_zero_state(1 << n, np.float32))
+
     t0 = time.perf_counter()
-    float(prog(a))
+    float(prog(fresh()))
     compile_s = time.perf_counter() - t0
     best = None
     for _ in range(3):
+        a = fresh()
         t0 = time.perf_counter()
         float(prog(a))
         dt = time.perf_counter() - t0
