@@ -524,3 +524,190 @@ def apply_cluster_stack(amps, mats_a, mats_b, *, precision=None, **kw):
     """See _apply_cluster_stack_jit."""
     return _apply_cluster_stack_jit(amps, mats_a, mats_b,
                                     precision=_resolved(precision), **kw)
+
+
+# ---------------------------------------------------------------------------
+# QFT ladder pass (Hadamard + whole controlled-phase ladder) as one Pallas
+# kernel — the XLA elementwise formulation measured ~9.2 ms per 26q layer
+# (it splits into multiple fusions around the pair-axis slice/stack); this
+# kernel is one HBM read + write with the phase from two host tables.
+# Reference layer semantics: agnostic_applyQFT, QuEST_common.c:836-898.
+# ---------------------------------------------------------------------------
+
+
+_TL_SPLIT = 1 << 11   # SMEM phase-table halves stay <= 2*2048*4 B = 16 KB
+
+
+def _qft_ladder_kernel(inv, RL):
+    def kernel(x_ref, tab_ref, tlo_ref, thi_ref, o_ref):
+        # x_ref: (2, 1, 2, RL, 128, 128); tlo/thi: SMEM factor tables over
+        # the low/high halves of the L index (each <= 16 KB regardless of
+        # target), phase_L(l) = tlo[l % SPLIT] * thi[l // SPLIT]
+        tab_re = tab_ref[0]                # (128, 128): bits 7-13 x 0-6
+        tab_im = tab_ref[1]
+        j = pl.program_id(1)
+        for r in range(RL):                # static unroll
+            x0r = x_ref[0, 0, 0, r]
+            x0i = x_ref[1, 0, 0, r]
+            x1r = x_ref[0, 0, 1, r]
+            x1i = x_ref[1, 0, 1, r]
+            l = j * RL + r
+            alo = tlo_ref[0, l % _TL_SPLIT]
+            blo = tlo_ref[1, l % _TL_SPLIT]
+            ahi = thi_ref[0, l // _TL_SPLIT]
+            bhi = thi_ref[1, l // _TL_SPLIT]
+            tlr = alo * ahi - blo * bhi
+            tli = alo * bhi + blo * ahi
+            ph_re = tlr * tab_re - tli * tab_im
+            ph_im = tlr * tab_im + tli * tab_re
+            dr = (x0r - x1r) * inv
+            di = (x0i - x1i) * inv
+            o_ref[0, 0, 0, r] = (x0r + x1r) * inv
+            o_ref[1, 0, 0, r] = (x0i + x1i) * inv
+            o_ref[0, 0, 1, r] = dr * ph_re - di * ph_im
+            o_ref[1, 0, 1, r] = dr * ph_im + di * ph_re
+
+    return kernel
+
+
+def _qft_ladder_jit(amps, tab, tlo, thi, *, num_qubits: int, target: int,
+                    interpret: bool | None = None):
+    n, t = num_qubits, target
+    L = 1 << (t - CLUSTER_QUBITS)          # bits 14..t-1
+    H = 1 << (n - 1 - t)                   # bits t+1..n-1
+    if interpret is None:
+        interpret = _interpret_default()
+    RL = min(L, 8)
+    view = amps.reshape(2, H, 2, L, CLUSTER_DIM, CLUSTER_DIM)
+    inv = 0.7071067811865476
+    out = pl.pallas_call(
+        _qft_ladder_kernel(inv, RL),
+        grid=(H, L // RL),
+        in_specs=[
+            pl.BlockSpec((2, 1, 2, RL, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, i, 0, j, 0, 0)),
+            pl.BlockSpec((2, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((2, 1, 2, RL, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i, j: (0, i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, tab, tlo, thi)
+    return out.reshape(2, -1)
+
+
+_qft_ladder_pallas_inner = partial(
+    jax.jit, static_argnames=("num_qubits", "target", "interpret"),
+    donate_argnums=0)(_qft_ladder_jit)
+
+
+def qft_ladder_supported(amps_dtype, num_qubits: int, target: int,
+                         base: int) -> bool:
+    """The Pallas ladder needs base 0, the pair bit above the 14-qubit
+    block (t >= 14), and a Mosaic-supported dtype on a real TPU."""
+    import numpy as _np
+
+    return (base == 0 and target >= LANE_QUBITS
+            and num_qubits > target
+            and num_qubits >= CLUSTER_QUBITS + 1
+            and _np.dtype(amps_dtype) == _np.float32
+            and not _interpret_default())
+
+
+def apply_qft_ladder_pallas(amps, *, num_qubits: int, target: int,
+                            conj: bool = False,
+                            interpret: bool | None = None):
+    """One QFT layer (H on ``target`` + controlled-phase ladder against
+    bits [0, target)) in ONE Pallas pass.  The phase e^{i pi low/2^t}
+    factorizes into a host (128, 128) table over bits [0, 14) and two
+    SMEM factor tables over the [14, t) index (split at 2^11 so each
+    stays <= 16 KB for any target)."""
+    import numpy as _np
+
+    n, t = num_qubits, target
+    sgn = -1.0 if conj else 1.0
+    dt = _np.dtype(amps.dtype)
+    if t < CLUSTER_QUBITS:
+        jlo = _np.arange(1 << t, dtype=_np.float64)
+        ang = sgn * _np.pi * jlo / (1 << t)
+        tab = _np.stack([_np.cos(ang), _np.sin(ang)]).reshape(
+            2, 1 << (t - LANE_QUBITS), CLUSTER_DIM).astype(dt)
+        return _qft_ladder_lo_jit(amps, jnp.asarray(tab),
+                                  num_qubits=n, target=t,
+                                  interpret=interpret)
+    j14 = _np.arange(1 << CLUSTER_QUBITS, dtype=_np.float64)
+    ang14 = sgn * _np.pi * j14 / (1 << t)
+    tab = _np.stack([_np.cos(ang14), _np.sin(ang14)]).reshape(
+        2, CLUSTER_DIM, CLUSTER_DIM).astype(dt)
+    L = 1 << (t - CLUSTER_QUBITS)
+    nlo = min(L, _TL_SPLIT)
+    jlo = _np.arange(nlo, dtype=_np.float64)
+    alo = sgn * _np.pi * jlo * (1 << CLUSTER_QUBITS) / (1 << t)
+    tlo = _np.stack([_np.cos(alo), _np.sin(alo)]).astype(dt)
+    nhi = max(1, L // _TL_SPLIT)
+    jhi = _np.arange(nhi, dtype=_np.float64)
+    ahi = (sgn * _np.pi * jhi * float(_TL_SPLIT)
+           * (1 << CLUSTER_QUBITS) / (1 << t))
+    thi = _np.stack([_np.cos(ahi), _np.sin(ahi)]).astype(dt)
+    return _qft_ladder_pallas_inner(
+        amps, jnp.asarray(tab), jnp.asarray(tlo), jnp.asarray(thi),
+        num_qubits=n, target=t, interpret=interpret)
+
+
+def _qft_ladder_lo_kernel(inv, t):
+    """Ladder layer for 7 <= t <= 13: the pair bit lives inside the
+    128-sublane axis, so the block reshapes its sublane factor and the
+    phase table (2, 2^(t-7), 128) aligns with in-block axes directly."""
+    s_hi = 1 << (13 - t)
+    s_lo = 1 << (t - LANE_QUBITS)
+
+    def kernel(x_ref, tab_ref, o_ref):
+        x = x_ref[...]                      # (2, R, 128, 128)
+        R = x.shape[1]
+        v = x.reshape(2, R, s_hi, 2, s_lo, CLUSTER_DIM)
+        x0 = v[:, :, :, 0]                  # (2, R, s_hi, s_lo, 128)
+        x1 = v[:, :, :, 1]
+        y0 = (x0 + x1) * inv
+        d = (x0 - x1) * inv
+        tr = tab_ref[0]                     # (s_lo, 128)
+        ti = tab_ref[1]
+        y1r = d[0] * tr - d[1] * ti
+        y1i = d[0] * ti + d[1] * tr
+        out_re = jnp.stack([y0[0], y1r], axis=2)   # (R, s_hi, 2, s_lo, 128)
+        out_im = jnp.stack([y0[1], y1i], axis=2)
+        out = jnp.stack([out_re, out_im])
+        o_ref[...] = out.reshape(2, R, CLUSTER_DIM, CLUSTER_DIM)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "target", "interpret"),
+         donate_argnums=0)
+def _qft_ladder_lo_jit(amps, tab, *, num_qubits: int, target: int,
+                       interpret: bool | None = None):
+    n, t = num_qubits, target
+    HI = 1 << (n - CLUSTER_QUBITS)
+    if interpret is None:
+        interpret = _interpret_default()
+    R = min(HI, 8)
+    view = amps.reshape(2, HI, CLUSTER_DIM, CLUSTER_DIM)
+    out = pl.pallas_call(
+        _qft_ladder_lo_kernel(0.7071067811865476, t),
+        grid=(HI // R,),
+        in_specs=[
+            pl.BlockSpec((2, R, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((2, 1 << (t - LANE_QUBITS), CLUSTER_DIM),
+                         lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, R, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, tab)
+    return out.reshape(2, -1)

@@ -868,6 +868,14 @@ def apply_qft_ladder(amps, *, num_qubits: int, target: int, base: int = 0,
     ~4 ms retile copies.
     """
     n, t = num_qubits, target
+    from . import fused as _fused
+
+    if _fused.qft_ladder_supported(amps.dtype, n, t, base):
+        # one Pallas pass (canonical layout, pair halves co-resident):
+        # ~3x the XLA elementwise formulation, which splits into several
+        # fusions around the pair-axis slice/stack
+        return _fused.apply_qft_ladder_pallas(
+            amps, num_qubits=n, target=t, conj=conj)
     tr = t - base
     lo = 1 << base         # untouched low axis (bra-twin case)
     hi = 1 << (n - 1 - t)

@@ -517,3 +517,46 @@ class TestNativeWindowedScheduler:
     def test_unknown_planner_rejected(self):
         with pytest.raises(ValueError, match="unknown planner"):
             C.plan_circuit([], 16, planner="window")
+
+
+class TestPallasQFTLadder:
+    """The Pallas ladder kernels (high: pair bit >= 14 with SMEM-table
+    phases; low: pair bit in the sublane axis) vs the XLA elementwise
+    formulation — interpret mode, since real-TPU selection is gated by
+    qft_ladder_supported."""
+
+    @pytest.mark.parametrize("t", [7, 9, 10, 13, 14, 15, 17])
+    @pytest.mark.parametrize("conj", [False, True])
+    def test_matches_xla_formulation(self, t, conj, monkeypatch):
+        n = 18
+        rng = np.random.default_rng(600 + t)
+        st = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        st /= np.sqrt((st ** 2).sum())
+        # force the XLA elementwise formulation for the reference
+        monkeypatch.setattr(fused, "qft_ladder_supported",
+                            lambda *a, **k: False)
+        ref = np.asarray(kernels.apply_qft_ladder(
+            jnp.asarray(st), num_qubits=n, target=t, conj=conj))
+        monkeypatch.undo()
+        # the SHIPPED wrapper (builds the tables), interpret mode on CPU
+        out = fused.apply_qft_ladder_pallas(
+            jnp.asarray(st), num_qubits=n, target=t, conj=conj,
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+    def test_two_level_smem_table_split(self, monkeypatch):
+        # shrink the split threshold so the high SMEM factor table is
+        # non-trivial (nhi > 1) at a small, fast size — exercises the
+        # l % SPLIT / l // SPLIT phase reconstruction used for t > 25
+        monkeypatch.setattr(fused, "_TL_SPLIT", 4)
+        n, t = 18, 17               # L = 8 > SPLIT -> nhi = 2
+        rng = np.random.default_rng(7)
+        st = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        st /= np.sqrt((st ** 2).sum())
+        out = fused.apply_qft_ladder_pallas(
+            jnp.asarray(st), num_qubits=n, target=t, interpret=True)
+        monkeypatch.setattr(fused, "qft_ladder_supported",
+                            lambda *a, **k: False)
+        ref = np.asarray(kernels.apply_qft_ladder(
+            jnp.asarray(st), num_qubits=n, target=t))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
