@@ -85,7 +85,12 @@ def config1():
 
 
 def config2():
-    """Delegates to bench.py (26q depth-20 random circuit, fused path)."""
+    """Delegates to bench.py (26q depth-20 random circuit, fused path).
+    The CPU smoke run shrinks the register: the full 26q plan through
+    interpret-mode Pallas on CPU takes tens of minutes."""
+    if CPU:
+        os.environ.setdefault("QT_BENCH_QUBITS", "16")
+        os.environ.setdefault("QT_BENCH_DEPTH", "4")
     import bench
 
     bench.main()
