@@ -1083,12 +1083,32 @@ def plan_circuit_windowed(gates: Sequence[Gate],
                     for k in range(max(k_lo, t - LANE + 1),
                                    min(k_hi, t) + 1):
                         cands.add(k)
+        # Windows k in {8, 9} force the collapsed 4-d state view (mid < 8,
+        # ops/fused.py): its layout differs from the canonical T(8,128)
+        # tiling, so XLA inserts full-state retile copies at the pass
+        # boundary — measured 5.9 ms vs 1.3 ms per pass at 26q, and an
+        # 8 GB OOM copy at 30q.  Whenever k >= 10 exists (n >= 17), any
+        # gate coverable by k=8/9 is also coverable by k=7 or k >= 10,
+        # so these offsets are never structurally necessary.
+        if k_hi >= 10:
+            cands -= {8, 9}
         best = None
         for k in sorted(cands):
             count, rank, folds = simulate(k)
             key = (count, -rank, -k)
             if best is None or key > best[0]:
                 best = (key, k, folds)
+        if best is None or best[0][0] == 0:
+            # last resort: retry the pruned offsets {8, 9} — a gate whose
+            # targets span exactly bits [8,14] or [9,15] is coverable by
+            # NO other window, and even the slow collapsed-4-d-view pass
+            # beats a per-gate full-state apply
+            for k in (8, 9):
+                if k_lo <= k <= k_hi:
+                    count, rank, folds = simulate(k)
+                    key = (count, -rank, -k)
+                    if count and (best is None or key > best[0]):
+                        best = (key, k, folds)
         if best is None or best[0][0] == 0:
             gi = ready[0]
             ops.append(("apply", glist[gi].targets, glist[gi].mat))
@@ -1152,6 +1172,10 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
             )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
+        elif op[0] == "sigma_swap":
+            from .ops import bigstate
+            amps = bigstate.apply_sigma_swap(
+                amps, num_qubits=n, group_bits=op[1], interpret=interpret)
         else:  # pragma: no cover
             raise ValueError(f"unknown op {op[0]}")
     return amps
@@ -1164,6 +1188,72 @@ def apply_circuit(amps, gates: Sequence[Gate], num_qubits: int,
                         interpret=interpret)
 
 
+# ---------------------------------------------------------------------------
+# Chained per-pass execution: many small cached programs, canonical layout
+# ---------------------------------------------------------------------------
+
+
+def canonical_view(amps, num_qubits: int):
+    """The state in its canonical tiled view (2, 2^(n-14), 128, 128) —
+    sublanes = amp bits [7,14), lanes = bits [0,7).  All per-pass kernels
+    accept and return this shape, and a jit parameter of this shape gets
+    the same T(8,128) device layout the kernel views use, so every jit
+    boundary is a free bitcast.  A flat (2, 2^n) parameter instead carries
+    a different layout and XLA inserts a FULL-STATE copy at the program
+    boundary — 537 MB at 26q, 8 GB at 30q (the round-2 OOM that blocked
+    the 30-qubit benchmark)."""
+    if num_qubits < WINDOW:
+        return amps
+    return amps.reshape(2, 1 << (num_qubits - WINDOW), DIM, DIM)
+
+
+def plan_to_device(ops: Sequence[tuple], dtype) -> List[tuple]:
+    """Upload every concrete pass operand once (numpy -> device array) so a
+    chained executor does not re-transfer matrices on every call."""
+    out: List[tuple] = []
+    for op in ops:
+        if op[0] in ("winfused",):
+            mask = op[6] if len(op) > 6 else None
+            out.append(("winfused", op[1], jnp.asarray(op[2], dtype),
+                        jnp.asarray(op[3], dtype), op[4], op[5],
+                        None if mask is None else jnp.asarray(mask, dtype)))
+        elif op[0] == "fused":
+            out.append(("fused", jnp.asarray(op[1], dtype),
+                        jnp.asarray(op[2], dtype)))
+        elif op[0] == "swapfused":
+            out.append(("swapfused", op[1], op[2], op[3],
+                        jnp.asarray(op[4], dtype), jnp.asarray(op[5], dtype)))
+        elif op[0] == "apply":
+            out.append(("apply", op[1], jnp.asarray(op[2], dtype)))
+        else:
+            out.append(op)
+    return out
+
+
+def execute_plan_chained(amps, ops: Sequence[tuple], num_qubits: int,
+                         precision: Optional[str] = None):
+    """Execute a plan as a CHAIN of per-pass cached jits (eager dispatch)
+    instead of one monolithic traced program.
+
+    Why this exists: tracing a whole 28-30q circuit into one XLA program
+    costs 7-14 minutes of AOT compile and, at 30q, an OOM (see
+    canonical_view).  Each pass here is its own tiny jitted program —
+    compiled once per distinct (kernel, k, rank, flags) signature in ~2 s,
+    reused across the whole circuit and across sizes with the same
+    signature.  Dispatch is async, so the host enqueues passes while the
+    device works; measured per-pass device time at 26q matches the HBM
+    floor (~1.3 ms), i.e. chaining costs nothing over the monolithic
+    program.  The state must be (and stays) in the canonical view.
+
+    This is the executor the 30q+ benchmark sizes use; the reference's
+    whole distributed design exists to reach those sizes
+    (QuEST/include/QuEST.h:463-479).
+    """
+    n = num_qubits
+    amps = canonical_view(amps, n)
+    return execute_plan(amps, ops, n, precision=precision)
+
+
 def stats(ops: Sequence[tuple]) -> dict:
     """Pass-count accounting for logging/benchmark output."""
     from collections import Counter
@@ -1172,7 +1262,9 @@ def stats(ops: Sequence[tuple]) -> dict:
     return {"fused": c.get("fused", 0), "swapfused": c.get("swapfused", 0),
             "winfused": c.get("winfused", 0),
             "apply": c.get("apply", 0), "segswap": c.get("segswap", 0),
-            "permute": c.get("permute", 0), "total_passes": sum(c.values())}
+            "permute": c.get("permute", 0),
+            "sigma_swap": c.get("sigma_swap", 0),
+            "total_passes": sum(c.values())}
 
 
 # ---------------------------------------------------------------------------
@@ -1270,6 +1362,28 @@ def _rev_perm_mat(bits: int, dt, off: int = 0) -> np.ndarray:
     return np.stack([m, np.zeros((d, d))]).astype(dt)
 
 
+def _bit_reversal_big(n: int, dt) -> List[tuple]:
+    """Bit reversal of the FULL state without any out-of-place transpose:
+    rev[0,n) = (within-group reversals, in-place window passes) o sigma
+    for the palindromic group split (7, 7, n-28, 7, 7), where sigma (swap
+    bits [0,7)<->[n-7,n) and [7,14)<->[n-14,n-7)) runs as the in-place
+    block-pair DMA kernel (ops/bigstate.py).  At 30q a full-state XLA
+    transpose OOMs (8 GB state + 8 GB output > 15.75 GB HBM); this path
+    is 5 in-place passes."""
+    r = n - 28
+    ops: List[tuple] = []
+    rev7 = jnp.asarray(_rev_perm_mat(LANE, dt))
+    eye = jnp.asarray(_eye_cluster(), rev7.dtype)
+    ops.append(("winfused", LANE, rev7[None], rev7[None], True, True))
+    if r:
+        m = jnp.asarray(_rev_perm_mat(r, dt, off=0))
+        ops.append(("winfused", WINDOW, eye[None], m[None], False, True))
+    for k in (WINDOW + r, n - LANE):
+        ops.append(("winfused", k, eye[None], rev7[None], False, True))
+    ops.append(("sigma_swap", LANE))
+    return ops
+
+
 def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
                      dt) -> Optional[List[tuple]]:
     """Ops reversing the qubit order of each contiguous run
@@ -1282,7 +1396,15 @@ def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
     window-pass permutation matrices at the groups' original positions
     (the lane group rides the A side of the first window pass), and the
     group-order reversal of ALL runs is ONE axis permutation whose long
-    order-preserving segments XLA transposes at near copy speed."""
+    order-preserving segments XLA transposes at near copy speed.
+
+    Full-state runs at n >= 30 take the in-place palindromic path
+    instead (_bit_reversal_big): the XLA transpose needs a second
+    full-state buffer, which no longer fits in HBM there."""
+    if (len(runs) == 1 and runs[0] == (0, n) and 30 <= n < 35
+            and np.dtype(dt) == np.float32
+            and not fused._interpret_default()):
+        return _bit_reversal_big(n, dt)
     ops: List[tuple] = []
     perm = list(range(n))
     eye = None

@@ -546,9 +546,16 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
           if (t >= kLane) {
             int64_t lo = std::max(k_lo, t - kLane + 1);
             int64_t hi = std::min(k_hi, t);
-            for (int64_t k = lo; k <= hi; ++k)
+            for (int64_t k = lo; k <= hi; ++k) {
+              // k in {8, 9} forces the collapsed 4-d state view whose
+              // layout breaks the canonical tiling (full-state retile
+              // copies at pass boundaries; OOM at 30q) — never
+              // structurally necessary once k >= 10 exists.  Mirrors
+              // circuit.plan_circuit_windowed.
+              if (k_hi >= 10 && (k == 8 || k == 9)) continue;
               if (std::find(cands.begin(), cands.end(), k) == cands.end())
                 cands.push_back(k);
+            }
           }
         }
       std::sort(cands.begin(), cands.end());
@@ -572,6 +579,29 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
           brank = rank;
           bk = k;
           bfolds = std::move(folds);
+        }
+      }
+      if (!have || bcount == 0) {
+        // last resort: retry the pruned offsets {8, 9} — a gate spanning
+        // exactly bits [8,14] or [9,15] has no other covering window
+        for (int64_t k = 8; k <= 9; ++k) {
+          if (k < k_lo || k > k_hi) continue;
+          std::vector<int64_t> folds;
+          int64_t rank;
+          int64_t count = simulate(k, folds, rank);
+          bool better = false;
+          if (count == 0) continue;
+          if (!have || bcount == 0) better = true;
+          else if (count != bcount) better = count > bcount;
+          else if (rank != brank) better = rank < brank;
+          else better = k < bk;
+          if (better) {
+            have = true;
+            bcount = count;
+            brank = rank;
+            bk = k;
+            bfolds = std::move(folds);
+          }
         }
       }
       if (!have || bcount == 0) {

@@ -1,0 +1,150 @@
+"""In-place kernels for states too large for out-of-place ops.
+
+At 30 qubits an f32 SoA state is 8 GB; the v5e chip exposes 15.75 GB of
+HBM, so ANY op that allocates a second full-state buffer (XLA transposes,
+layout copies) is an OOM.  The reference meets this wall by distributing
+(QuEST/include/QuEST.h:463-479 documents the per-node memory doubling);
+the fused Pallas passes dodge it with input/output aliasing — but the
+QFT's final bit-reversal permutation (agnostic_applyQFT swap network,
+QuEST_common.c:836-898) is a full-state transpose that XLA can only do
+out-of-place.
+
+This module provides the missing piece: an IN-PLACE "double bit-block
+swap" kernel built on manual DMA with the state aliased as its own
+output.  It exchanges amp bits [0,g) <-> [n-g, n) and [g,2g) <-> [n-2g,
+n-g) simultaneously (bits [2g, n-2g) fixed) — an involution sigma.  The
+full bit reversal factors as
+
+    rev[0,n) = (within-group reversals) o sigma
+
+for the palindromic group split (g, g, n-4g, g, g), and the within-group
+reversals are ordinary in-place window passes (circuit.bit_reversal_ops).
+
+Why sigma is in-place blockable: fix (G1=c, s=d) and let (G2, l) range —
+call that block B(c,d) (a 128x128 slab for g=7).  sigma maps B(c,d) onto
+B(d,c) with the slab transposed, so blocks pair up under sigma and a
+kernel can stage the two slabs in VMEM, transpose, and write them back
+swapped — each element moved exactly once, no second state buffer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _sigma_kernel():
+    """Kernel body: one unordered (c, d) block pair per grid step.
+
+    refs: ctab/dtab (scalar prefetch, SMEM), in_ref/out_ref = the SAME
+    HBM buffer (aliased), scratch s1/s2 (2, G, G) VMEM, 4 DMA sems.
+    View indexed [ch, G2, G1, b, s, l]; slab (c, d) = [:, :, c, b, d, :].
+    """
+
+    def kernel(ctab, dtab, in_ref, out_ref, s1, s2, sems):
+        j = pl.program_id(1)
+        b = pl.program_id(0)
+        c = ctab[j]
+        d = dtab[j]
+
+        r1 = pltpu.make_async_copy(
+            in_ref.at[:, :, c, b, d, :], s1, sems.at[0])
+        r1.start()
+        r2 = pltpu.make_async_copy(
+            in_ref.at[:, :, d, b, c, :], s2, sems.at[1])
+        r2.start()
+        r1.wait()
+        r2.wait()
+        t1 = jnp.swapaxes(s2[...], 1, 2)
+        t2 = jnp.swapaxes(s1[...], 1, 2)
+        s1[...] = t1
+        s2[...] = t2
+        # writes serialized: a diagonal step (c == d) writes the same slab
+        # twice (same transposed data); concurrent overlapping writes
+        # would be a DMA hazard even with identical bytes
+        w1 = pltpu.make_async_copy(
+            s1, out_ref.at[:, :, c, b, d, :], sems.at[2])
+        w1.start()
+        w1.wait()
+        w2 = pltpu.make_async_copy(
+            s2, out_ref.at[:, :, d, b, c, :], sems.at[3])
+        w2.start()
+        w2.wait()
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "group_bits", "interpret"),
+         donate_argnums=0)
+def _sigma_swap_jit(amps, ctab, dtab, *, num_qubits: int, group_bits: int,
+                    interpret: bool | None = None):
+    n, g = num_qubits, group_bits
+    if interpret is None:
+        from .fused import _interpret_default
+
+        interpret = _interpret_default()
+    G = 1 << g
+    r = n - 4 * g
+    B = 1 << r
+    in_shape = amps.shape
+    view = amps.reshape(2, G, G, B, G, G)
+    npairs = ctab.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, npairs),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, G, G), view.dtype),
+            pltpu.VMEM((2, G, G), view.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    out = pl.pallas_call(
+        _sigma_kernel(),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        # operand indices count the scalar-prefetch args: 2 = the state
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(ctab, dtab, view)
+    return out.reshape(in_shape)
+
+
+def sigma_pair_tables(group_bits: int):
+    """(ctab, dtab) int32 arrays enumerating unordered (c, d) pairs,
+    diagonal included (a diagonal step writes the same slab twice with the
+    same transposed data — harmless and branch-free)."""
+    G = 1 << group_bits
+    cs, ds = np.triu_indices(G)
+    return (np.asarray(cs, np.int32), np.asarray(ds, np.int32))
+
+
+def apply_sigma_swap(amps, *, num_qubits: int, group_bits: int = 7,
+                     interpret: bool | None = None):
+    """In-place involution sigma: swap amp bits [0,g) <-> [n-g, n) AND
+    [g, 2g) <-> [n-2g, n-g); bits [2g, n-2g) unchanged.  Requires
+    4*group_bits <= num_qubits.  One HBM read + one write of the state,
+    zero extra HBM (the state buffer is aliased as its own output)."""
+    if 4 * group_bits > num_qubits:
+        raise ValueError("sigma swap needs n >= 4*group_bits")
+    ctab, dtab = sigma_pair_tables(group_bits)
+    return _sigma_swap_jit(
+        amps, jnp.asarray(ctab), jnp.asarray(dtab),
+        num_qubits=num_qubits, group_bits=group_bits, interpret=interpret)
+
+
+def sigma_perm(num_qubits: int, group_bits: int) -> tuple:
+    """The bit permutation sigma implements, as a perm tuple compatible
+    with kernels.permute_qubits (output qubit q holds input perm[q])."""
+    n, g = num_qubits, group_bits
+    perm = list(range(n))
+    for j in range(g):
+        perm[j], perm[n - g + j] = n - g + j, j
+        perm[g + j], perm[n - 2 * g + j] = n - 2 * g + j, g + j
+    return tuple(perm)

@@ -255,8 +255,10 @@ def _apply_swap_cluster_stack_jit(
 ):
     """Segment swap [h, h+m) <-> [b, b+m) followed by the rank-R window
     operator sum_r B_r (x) A_r, in ONE HBM pass (see _cluster_swap_kernel).
-    Requires h >= 14, 7 <= b and b + m <= 14, m <= MAX_FUSED_SWAP_M."""
+    Requires h >= 14, 7 <= b and b + m <= 14, m <= MAX_FUSED_SWAP_M.
+    Result shape = input shape."""
     n = num_qubits
+    in_shape = amps.shape
     interpret = _resolve_interpret(interpret, amps)
     rank = mats_a.shape[0]
     M = 1 << m
@@ -284,7 +286,7 @@ def _apply_swap_cluster_stack_jit(
         input_output_aliases={0: 0},
         interpret=interpret,
     )(view, ma, mb)
-    return out.reshape(2, -1)
+    return out.reshape(in_shape)
 
 
 def _window_kernel(rank, apply_a, apply_b, prec=jax.lax.Precision.HIGHEST,
@@ -362,8 +364,17 @@ def _apply_window_stack_jit(
     k > 7 replaces a segswap-relocate + cluster + restore sequence — the
     single-chip analogue of choosing which qubits are "local", cf. the
     reference's SWAP-relocalization (QuEST_cpu_distributed.c:1503-1545).
+
+    ``amps`` may be any full-size view of the state (flat (2, 2^n) or the
+    canonical (2, nb, 128, 128)); the result is returned in the SAME
+    shape.  Chained per-pass callers (circuit.execute_plan_chained) keep
+    the canonical view across jit boundaries — a flat (2, 2^n) parameter
+    carries a device layout that differs from the kernels' T(8,128) tiled
+    view, forcing XLA to insert a FULL-STATE layout copy at the program
+    boundary (8 GB at 30q: the round-2 "30q never reaches the chip" OOM).
     """
     n = num_qubits
+    in_shape = amps.shape
     if not (LANE_QUBITS <= k <= n - SUBLANE_QUBITS):
         raise ValueError(f"window offset {k} out of range for n={n}")
     interpret = _resolve_interpret(interpret, amps)
@@ -441,7 +452,7 @@ def _apply_window_stack_jit(
         input_output_aliases={0: 0},
         interpret=interpret,
     )(*operands)
-    return out.reshape(2, -1)
+    return out.reshape(in_shape)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "block_rows", "interpret",
@@ -462,8 +473,10 @@ def _apply_cluster_stack_jit(
     ``mats_a``/``mats_b``: stacked SoA (R, 2, 128, 128).  R > 1 encodes
     lane-x-sublane-crossing gates folded by the scheduler (circuit.py)
     through the |a><b| block decomposition — the pass costs R matmul pairs
-    but still exactly one state read + write."""
+    but still exactly one state read + write.  Result shape = input shape
+    (see _apply_window_stack_jit on canonical views)."""
     n = num_qubits
+    in_shape = amps.shape
     if n < CLUSTER_QUBITS:
         raise ValueError(f"apply_cluster_stack needs >= {CLUSTER_QUBITS} qubits")
     interpret = _resolve_interpret(interpret, amps)
@@ -492,7 +505,7 @@ def _apply_cluster_stack_jit(
         input_output_aliases={0: 0},
         interpret=interpret,
     )(view, ma, mb)
-    return out.reshape(2, -1)
+    return out.reshape(in_shape)
 
 
 def _resolved(precision):
@@ -573,6 +586,7 @@ def _qft_ladder_kernel(inv, RL):
 def _qft_ladder_jit(amps, tab, tlo, thi, *, num_qubits: int, target: int,
                     interpret: bool | None = None):
     n, t = num_qubits, target
+    in_shape = amps.shape
     L = 1 << (t - CLUSTER_QUBITS)          # bits 14..t-1
     H = 1 << (n - 1 - t)                   # bits t+1..n-1
     if interpret is None:
@@ -597,7 +611,7 @@ def _qft_ladder_jit(amps, tab, tlo, thi, *, num_qubits: int, target: int,
         input_output_aliases={0: 0},
         interpret=interpret,
     )(view, tab, tlo, thi)
-    return out.reshape(2, -1)
+    return out.reshape(in_shape)
 
 
 _qft_ladder_pallas_inner = partial(
@@ -690,6 +704,7 @@ def _qft_ladder_lo_kernel(inv, t):
 def _qft_ladder_lo_jit(amps, tab, *, num_qubits: int, target: int,
                        interpret: bool | None = None):
     n, t = num_qubits, target
+    in_shape = amps.shape
     HI = 1 << (n - CLUSTER_QUBITS)
     if interpret is None:
         interpret = _interpret_default()
@@ -710,4 +725,4 @@ def _qft_ladder_lo_jit(amps, tab, *, num_qubits: int, target: int,
         input_output_aliases={0: 0},
         interpret=interpret,
     )(view, tab)
-    return out.reshape(2, -1)
+    return out.reshape(in_shape)

@@ -481,13 +481,16 @@ def apply_matrix(
     ``matrix`` is stacked SoA (2, 2^k, 2^k).
     """
     n = num_qubits
+    in_shape = amps.shape
     matrix = jnp.asarray(matrix, amps.dtype)
     if controls:
-        return _apply_with_controls(
+        out = _apply_with_controls(
             amps, n, controls, control_states, targets,
             lambda sub, sub_n, sub_t: _apply_matrix_flat(sub, sub_n, sub_t, matrix),
         )
-    return _apply_matrix_flat(amps, n, targets, matrix)
+    else:
+        out = _apply_matrix_flat(amps, n, targets, matrix)
+    return out.reshape(in_shape)
 
 
 def _apply_diagonal_flat(amps, n: int, targets, diag):
@@ -558,13 +561,16 @@ def apply_diagonal(
     stacked SoA (2, 2^k), exponentiated host-side — no transcendental runs
     per amplitude."""
     n = num_qubits
+    in_shape = amps.shape
     diag = jnp.asarray(diag, amps.dtype)
     if controls:
-        return _apply_with_controls(
+        out = _apply_with_controls(
             amps, n, controls, control_states, targets,
             lambda sub, sub_n, sub_t: _apply_diagonal_flat(sub, sub_n, sub_t, diag),
         )
-    return _apply_diagonal_flat(amps, n, targets, diag)
+    else:
+        out = _apply_diagonal_flat(amps, n, targets, diag)
+    return out.reshape(in_shape)
 
 
 @partial(
@@ -590,7 +596,9 @@ def apply_parity_phase(
         ang = -0.5 * theta
         if sub_n <= 31:
             # flat sign: partitions along the sharded amplitude axis with
-            # zero communication (see parity_sign_flat)
+            # zero communication (see parity_sign_flat); flatten first so a
+            # canonical 4-d view input broadcasts correctly
+            sub = sub.reshape(2, -1)
             s = parity_sign_flat(sub_n, sub_qubits, amps.dtype)
             return cplx.cmul(sub, jnp.cos(ang), jnp.sin(ang) * s)
         s = parity_sign_2d(sub_n, sub_qubits, amps.dtype)
@@ -600,11 +608,13 @@ def apply_parity_phase(
         return out.reshape(2, -1)
 
     if controls:
-        return _apply_with_controls(
+        out = _apply_with_controls(
             amps, n, controls, control_states, qubits,
             lambda sub, sub_n, sub_q: phased(sub, sub_n, sub_q),
         )
-    return phased(amps, n, qubits)
+    else:
+        out = phased(amps, n, qubits)
+    return out.reshape(amps.shape)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "targets", "controls", "control_states"), donate_argnums=0)
@@ -622,11 +632,13 @@ def apply_multi_qubit_not(
     loop (QuEST_cpu.c:2554-2660)."""
     n = num_qubits
     if controls:
-        return _apply_with_controls(
+        out = _apply_with_controls(
             amps, n, controls, control_states, targets,
             lambda sub, sub_n, sub_t: _flip_bits_flat(sub, sub_n, sub_t),
         )
-    return _flip_bits_flat(amps, n, targets)
+    else:
+        out = _flip_bits_flat(amps, n, targets)
+    return out.reshape(amps.shape)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "perm"), donate_argnums=0)
@@ -642,7 +654,7 @@ def permute_qubits(amps, *, num_qubits: int, perm: Tuple[int, ...]):
     sees is low-rank (a rank-(n+1) transpose makes the TPU backend's compile
     time explode past n≈18); permutations that still would not coalesce are
     decomposed into pairwise swaps, each itself a rank-<=6 transpose."""
-    return _permute_impl(amps, num_qubits, perm)
+    return _permute_impl(amps, num_qubits, perm).reshape(amps.shape)
 
 
 def _permute_impl(amps, n: int, perm: Tuple[int, ...]):
@@ -702,7 +714,7 @@ def swap_qubit_amps(amps, *, num_qubits: int, qb1: int, qb2: int):
     QuEST_cpu.c:3882-3964, which the distributed layer also uses for
     relocalization, QuEST_cpu_distributed.c:1447-1545).  Expressed as a
     rank-6 transpose over coalesced bit blocks, independent of n."""
-    return _swap_impl(amps, num_qubits, qb1, qb2)
+    return _swap_impl(amps, num_qubits, qb1, qb2).reshape(amps.shape)
 
 
 _SWAP_SOA = np.zeros((2, 4, 4))
@@ -738,7 +750,7 @@ def swap_bit_segments(amps, *, num_qubits: int, a: int, b: int, m: int):
     view = amps.reshape(
         2, 1 << (n - a - m), 1 << m, 1 << (a - b - m), 1 << m, 1 << b
     )
-    return jnp.transpose(view, (0, 1, 4, 3, 2, 5)).reshape(2, -1)
+    return jnp.transpose(view, (0, 1, 4, 3, 2, 5)).reshape(amps.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -806,7 +818,7 @@ def collapse_statevec(amps, prob, *, num_qubits: int, target: int, outcome: int)
     scale = (1.0 / jnp.sqrt(jnp.asarray(prob, amps.dtype)))
     ind = bit_indicator_2d(n, ((target, outcome),), amps.dtype)
     view = amps.reshape(2, ind.shape[0], ind.shape[1])
-    return (view * (scale * ind)[None]).reshape(2, -1)
+    return (view * (scale * ind)[None]).reshape(amps.shape)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "target", "outcome"), donate_argnums=0)
@@ -923,4 +935,4 @@ def apply_qft_ladder(amps, *, num_qubits: int, target: int, base: int = 0,
         jnp.stack([y0r, y1r], axis=1),
         jnp.stack([y0i, y1i], axis=1),
     ])
-    return out.reshape(2, -1)
+    return out.reshape(amps.shape)

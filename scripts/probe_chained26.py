@@ -1,0 +1,75 @@
+"""Probe: real 26q bench circuit, chained executor vs monolithic numbers.
+
+Reports compile wall, steady wall, K-diff device time per circuit.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as C
+from quest_tpu.models import circuits
+from quest_tpu.ops import calculations
+
+N = int(os.environ.get("QT_PROBE_QUBITS", "26"))
+DEPTH = 20
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    log(devices=str(jax.devices()))
+    fn, us = circuits.build_random_circuit(N, DEPTH, seed=7)
+    us = np.asarray(us)
+    cnot = np.zeros((2, 4, 4), np.float32)
+    cnot[0] = np.array(
+        [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], np.float32)
+    gates = []
+    for d in range(DEPTH):
+        for q in range(N):
+            gates.append(C.Gate((q,), us[d, q]))
+        for q in range(d % 2, N - 1, 2):
+            gates.append(C.Gate((q, q + 1), cnot))
+
+    t0 = time.perf_counter()
+    ops = C.plan_to_device(C.plan_circuit(gates, N), jnp.float32)
+    log(plan_s=round(time.perf_counter() - t0, 2), passes=len(ops))
+
+    nb = 1 << (N - 14)
+
+    def fresh():
+        return jnp.zeros((2, nb, 128, 128), jnp.float32).at[0, 0, 0, 0].set(1.0)
+
+    def run(k=1):
+        a = fresh()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = C.execute_plan_chained(a, ops, N)
+        p = float(calculations.calc_prob_of_outcome_statevec(
+            a, num_qubits=N, target=N - 1, outcome=0))
+        return time.perf_counter() - t0, p
+
+    t0 = time.perf_counter()
+    _, p = run()
+    log(stage="chained compile+first", s=round(time.perf_counter() - t0, 1), prob=p)
+
+    t1s = [run(1)[0] for _ in range(5)]
+    t2s = [run(2)[0] for _ in range(5)]
+    log(stage="chained steady", wall_1x=round(min(t1s), 4),
+        wall_2x=round(min(t2s), 4),
+        kdiff_device_s=round(min(t2s) - min(t1s), 4),
+        t1s=[round(t, 4) for t in t1s], t2s=[round(t, 4) for t in t2s],
+        prob=p)
+
+
+if __name__ == "__main__":
+    main()

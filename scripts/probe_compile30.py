@@ -1,0 +1,118 @@
+"""Probe: per-program compile costs at 30q on the real chip.
+
+E1: one window pass (k=14) as its own jitted program (chained-execution unit)
+E2: lax.scan over stacked pass tables (2-pass body, 10 iterations)
+E3: one QFT ladder pass (target=25)
+E4: calc_prob_of_outcome at 30q
+
+Each stage prints a JSON line with compile seconds and steady wall.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from quest_tpu import circuit as C
+from quest_tpu.ops import calculations, fused, kernels
+
+N = int(os.environ.get("QT_PROBE_QUBITS", "30"))
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def fresh():
+    return jnp.asarray(kernels.init_zero_state(1 << N, np.float32))
+
+
+def main():
+    t0 = time.perf_counter()
+    log(devices=str(jax.devices()), init_s=round(time.perf_counter() - t0, 1))
+
+    rng = np.random.default_rng(0)
+
+    def rand_soa(k):
+        d = 1 << k
+        z = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+        q, r = np.linalg.qr(z)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag]).astype(np.float32)
+
+    a128 = C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None]
+    b128 = C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None]
+
+    # E1: one window pass k=14, standalone jit (already a jit in fused.py)
+    amps = fresh()
+    t0 = time.perf_counter()
+    amps = fused.apply_window_stack(amps, a128, b128, num_qubits=N, k=14)
+    amps.block_until_ready()
+    c1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    amps = fused.apply_window_stack(amps, a128, b128, num_qubits=N, k=14)
+    amps.block_until_ready()
+    w1 = time.perf_counter() - t0
+    log(stage="E1 window k=14", compile_s=round(c1, 1), steady_s=round(w1, 3))
+
+    # E1b: second distinct k (k=20) — incremental compile cost of one more sig
+    t0 = time.perf_counter()
+    amps = fused.apply_window_stack(amps, a128, b128, num_qubits=N, k=20)
+    amps.block_until_ready()
+    c1b = time.perf_counter() - t0
+    log(stage="E1b window k=20", compile_s=round(c1b, 1))
+
+    # E2: scan over stacked tables: body = 2 window passes (k=7, k=14)
+    P = 10
+    As = jnp.asarray(np.repeat(a128[None], P, axis=0))
+    Bs = jnp.asarray(np.repeat(b128[None], P, axis=0))
+
+    @partial(jax.jit, donate_argnums=0)
+    def scan_prog(amps, As, Bs):
+        def body(a, xs):
+            aa, bb = xs
+            a = fused.apply_window_stack(a, aa, bb, num_qubits=N, k=7)
+            a = fused.apply_window_stack(a, aa, bb, num_qubits=N, k=14)
+            return a, None
+        a, _ = jax.lax.scan(body, amps, (As, Bs))
+        return a
+
+    t0 = time.perf_counter()
+    amps = scan_prog(amps, As, Bs)
+    amps.block_until_ready()
+    c2 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    amps = scan_prog(amps, As, Bs)
+    amps.block_until_ready()
+    w2 = time.perf_counter() - t0
+    log(stage="E2 scan 10x(k7+k14)", compile_s=round(c2, 1), steady_s=round(w2, 3),
+        per_pass_ms=round(w2 / (2 * P) * 1e3, 1))
+
+    # E3: QFT ladder target=25
+    t0 = time.perf_counter()
+    amps = fused.apply_qft_ladder_pallas(amps, num_qubits=N, target=25)
+    amps.block_until_ready()
+    c3 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    amps = fused.apply_qft_ladder_pallas(amps, num_qubits=N, target=25)
+    amps.block_until_ready()
+    w3 = time.perf_counter() - t0
+    log(stage="E3 qft ladder t=25", compile_s=round(c3, 1), steady_s=round(w3, 3))
+
+    # E4: prob reduction
+    t0 = time.perf_counter()
+    p = float(calculations.calc_prob_of_outcome_statevec(
+        amps, num_qubits=N, target=N - 1, outcome=0))
+    c4 = time.perf_counter() - t0
+    log(stage="E4 calc_prob", compile_s=round(c4, 1), prob=p)
+
+
+if __name__ == "__main__":
+    main()

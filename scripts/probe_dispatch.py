@@ -1,0 +1,76 @@
+"""Probe: true per-pass device cost per signature, K-differenced around a
+host fetch (the relay acks block_until_ready at enqueue; only a fetch
+syncs)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as C
+from quest_tpu.ops import fused
+
+N = int(os.environ.get("QT_PROBE_QUBITS", "26"))
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    log(devices=str(jax.devices()))
+    rng = np.random.default_rng(0)
+
+    def rand_soa(k):
+        d = 1 << k
+        z = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+        q, r = np.linalg.qr(z)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag]).astype(np.float32)
+
+    a128 = jnp.asarray(C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None])
+    b128 = jnp.asarray(C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None])
+    mask = jnp.asarray(np.stack([np.ones((128, 128)), np.zeros((128, 128))])
+                       .astype(np.float32))
+    nb = 1 << (N - 14)
+
+    def fresh():
+        return jnp.zeros((2, nb, 128, 128), jnp.float32).at[0, 0, 0, 0].set(1.0)
+
+    def run(ks, reps, masked=False, b_only=False):
+        a = fresh()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for k in ks:
+                a = fused.apply_window_stack(
+                    a, a128, b128, mask if masked else None,
+                    num_qubits=N, k=k, apply_a=not b_only)
+        float(a[0, 0, 0, 0])  # fetch = the only reliable sync
+        return time.perf_counter() - t0
+
+    def kdiff(name, ks, r1, r2, **kw):
+        run(ks, 1, **kw)  # compile warm
+        t1 = min(run(ks, r1, **kw) for _ in range(3))
+        t2 = min(run(ks, r2, **kw) for _ in range(3))
+        n_extra = (r2 - r1) * len(ks)
+        log(stage=name, per_pass_ms=round((t2 - t1) / n_extra * 1e3, 2),
+            t1=round(t1, 4), t2=round(t2, 4))
+
+    kdiff("A+B k=14", [14], 4, 12)
+    kdiff("A+B alt k=14/15/17/18", [14, 15, 17, 18], 1, 3)
+    kdiff("B-only k=14", [14], 4, 12, b_only=True)
+    kdiff("A+B masked k=7", [7], 4, 12, masked=True)
+    kdiff("B-only masked k=7", [7], 4, 12, masked=True, b_only=True)
+    kdiff("A+B k=8 (4d view)", [8], 4, 12)
+    kdiff("B-only k=8 (4d view)", [8], 4, 12, b_only=True)
+    kdiff("B-only k=12", [12], 4, 12, b_only=True)
+
+
+if __name__ == "__main__":
+    main()

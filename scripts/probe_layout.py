@@ -1,0 +1,96 @@
+"""Probe: does a flat (2, 2^n) state param force a full-state layout copy
+at the jit boundary, and does a canonical (2, nb, 128, 128) param avoid it?
+
+Uses compiled.memory_analysis() (temp bytes) at 26q, then steady timing.
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as C
+from quest_tpu.ops import fused, kernels
+
+N = int(os.environ.get("QT_PROBE_QUBITS", "26"))
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    log(devices=str(jax.devices()))
+    rng = np.random.default_rng(0)
+
+    def rand_soa(k):
+        d = 1 << k
+        z = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+        q, r = np.linalg.qr(z)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag]).astype(np.float32)
+
+    a128 = C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None]
+    b128 = C.embed_in_cluster(rand_soa(7), tuple(range(7)))[None]
+    nb = 1 << (N - 14)
+
+    @partial(jax.jit, donate_argnums=0)
+    def flat_pass(amps, ma, mb):
+        return fused._apply_window_stack_jit(
+            amps, ma, mb, num_qubits=N, k=14)
+
+    @partial(jax.jit, donate_argnums=0)
+    def canon_pass(amps4, ma, mb):
+        out = fused._apply_window_stack_jit(
+            amps4.reshape(2, -1), ma, mb, num_qubits=N, k=14)
+        return out.reshape(2, nb, 128, 128)
+
+    flat = jax.ShapeDtypeStruct((2, 1 << N), jnp.float32)
+    canon = jax.ShapeDtypeStruct((2, nb, 128, 128), jnp.float32)
+    m = jax.ShapeDtypeStruct((1, 2, 128, 128), jnp.float32)
+
+    for name, fn, st in (("flat", flat_pass, flat), ("canon", canon_pass, canon)):
+        t0 = time.perf_counter()
+        comp = fn.lower(st, m, m).compile()
+        cs = time.perf_counter() - t0
+        ma = comp.memory_analysis()
+        log(stage=f"{name} k=14 n={N}", compile_s=round(cs, 1),
+            temp_mb=round(ma.temp_size_in_bytes / 1e6, 1),
+            arg_mb=round(ma.argument_size_in_bytes / 1e6, 1),
+            out_mb=round(ma.output_size_in_bytes / 1e6, 1),
+            alias_mb=round(ma.alias_size_in_bytes / 1e6, 1))
+
+    # steady-state timing comparison (K-diff style: 8 passes vs 4 passes)
+    def chain(fn, st0, reps):
+        a = st0
+        for _ in range(reps):
+            a = fn(a, jnp.asarray(a128), jnp.asarray(b128))
+        return a
+
+    for name, fn, shape in (("flat", flat_pass, (2, 1 << N)),
+                            ("canon", canon_pass, (2, nb, 128, 128))):
+        a = jnp.zeros(shape, jnp.float32)
+        a = chain(fn, a, 2)
+        a.block_until_ready()
+        ts = []
+        for reps in (4, 8, 4, 8, 4, 8):
+            a = jnp.zeros(shape, jnp.float32)
+            t0 = time.perf_counter()
+            a = chain(fn, a, reps)
+            a.block_until_ready()
+            ts.append((reps, time.perf_counter() - t0))
+        t4 = min(t for r, t in ts if r == 4)
+        t8 = min(t for r, t in ts if r == 8)
+        log(stage=f"{name} chained timing", per_pass_ms=round((t8 - t4) / 4 * 1e3, 2),
+            t4=round(t4, 3), t8=round(t8, 3))
+
+
+if __name__ == "__main__":
+    main()
