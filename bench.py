@@ -1,14 +1,21 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
 Workload = BASELINE.json config 2: 26-qubit state-vector, depth-20 random
-circuit of 1q unitaries + CNOT ladder, single chip, whole circuit traced
-into one jitted XLA program.  Metric: amplitude-updates per second
-(gates x 2^N / wall-clock) — the gate-apply rate of BASELINE.json.
+circuit of 1q unitaries + CNOT ladder, single chip.  Metric: amplitude-
+updates per second (gates x 2^N / device-seconds) — the gate-apply rate
+of BASELINE.json.
+
+Execution (round 3): CHAINED — the plan runs as a sequence of per-pass
+cached jitted programs with the state held in the canonical
+(2, nb, 128, 128) tiled view between calls (circuit.execute_plan_chained).
+vs the round-2 monolithic whole-circuit trace this removes the full-state
+boundary layout copy and cuts compile from minutes to ~30 s, and is what
+lets the same code scale to 30 qubits (see BASELINE.md round-3 section).
 
 vs_baseline compares against the reference QuEST CPU backend (upstream
-sagudeloo/QuEST built -DMULTITHREADED=1, Release, double precision) running
-the IDENTICAL circuit shape on the build host (single hardware core —
-see BASELINE.md for the measured record).
+sagudeloo/QuEST built -DMULTITHREADED=1, Release, double precision)
+running the IDENTICAL circuit shape on the build host (single hardware
+core — see BASELINE.md for the measured record).
 """
 
 import json
@@ -28,6 +35,7 @@ if os.environ.get("QT_BENCH_CPU") == "1":
     # axon relay, the config update is the reliable route
     jax.config.update("jax_platforms", "cpu")
 
+import jax.numpy as jnp
 import numpy as np
 
 import quest_tpu as qt
@@ -43,93 +51,73 @@ BASELINE_AMPS_PER_SEC = 3.493e8
 
 N = int(os.environ.get("QT_BENCH_QUBITS", "26"))
 DEPTH = int(os.environ.get("QT_BENCH_DEPTH", "20"))
-REPS = int(os.environ.get("QT_BENCH_REPS", "3"))
-# Fused scheduler path (Pallas cluster kernel + permutes, quest_tpu.circuit)
-# vs per-gate einsum path; identical circuit either way.
-FUSED = os.environ.get("QT_BENCH_FUSED", "1") == "1" and N >= 14
-
-
-def _build_fused_program():
-    """Same circuit as circuits.build_random_circuit, as a scheduled plan:
-    gate matrices stay traced args so angle changes never recompile."""
-    import numpy as _np
-
-    from quest_tpu import circuit as C
-
-    # CNOT with control = matrix bit 0 (= targets[0] = q), target = bit 1:
-    # flips bit 1 on states where bit 0 is set (indices 1 <-> 3)
-    cnot = _np.zeros((2, 4, 4), _np.float32)
-    cnot[0] = _np.array(
-        [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], _np.float32
-    )
-
-    def program(amps, us):
-        gates = []
-        for d in range(DEPTH):
-            for q in range(N):
-                gates.append(C.Gate((q,), us[d, q]))
-            for q in range(d % 2, N - 1, 2):
-                gates.append(C.Gate((q, q + 1), cnot))
-        amps = C.apply_circuit(amps, gates, N)
-        prob = calculations.calc_prob_of_outcome_statevec(
-            amps, num_qubits=N, target=N - 1, outcome=0
-        )
-        return amps, prob
-
-    return program
+REPS = int(os.environ.get("QT_BENCH_REPS", "5"))
+# Fused scheduler path (windowed plan + Pallas window kernels) vs per-gate
+# einsum path; identical circuit either way.  The chained executor needs
+# the canonical view (n >= 15).
+FUSED = os.environ.get("QT_BENCH_FUSED", "1") == "1" and N >= 15
 
 
 def main():
-    fn, unitaries = circuits.build_random_circuit(N, DEPTH, seed=7)
+    from quest_tpu import circuit as C
 
-    if FUSED:
-        program = _build_fused_program()
-    else:
-        def program(amps, us):
-            amps = fn(amps, us)
-            prob = calculations.calc_prob_of_outcome_statevec(
-                amps, num_qubits=N, target=N - 1, outcome=0
-            )
-            return amps, prob
-
-    # Timing methodology: a device->host fetch through the axon relay
-    # costs ~100 ms and dispatch another ~50 ms — FIXED per-call overheads
-    # of the test harness (a production TPU dispatches in <1 ms), measured
-    # 2026-07-30: scalar jit+fetch = 102-108 ms regardless of payload.  A
-    # single-call wall clock would therefore measure the relay, not the
-    # framework.  We K-difference instead: T(2 circuits in one program) -
-    # T(1 circuit) = pure device time per circuit; both overheads cancel.
-    # The raw single-call wall clock is also reported for transparency.
-    def prog_K(K):
-        def p(amps, us):
-            prob = None
-            for _ in range(K):
-                amps, prob = program(amps, us)
-            return amps, prob
-        return jax.jit(p, donate_argnums=0)
-
-    jprog1, jprog2 = prog_K(1), prog_K(2)
-
+    fn, us = circuits.build_random_circuit(N, DEPTH, seed=7)
     num_gates = DEPTH * N + sum(
         1 for d in range(DEPTH) for t in range(N - 1) if (d + t) % 2 == 0
     )
 
-    def run(jp):
-        amps = kernels.init_zero_state(1 << N, np.float32)
-        t0 = time.perf_counter()
-        _, prob = jp(amps, unitaries)
-        float(prob)  # the only reliable device sync under the relay
-        return time.perf_counter() - t0, float(prob)
+    if FUSED:
+        ops = C.plan_to_device(
+            C.plan_circuit(circuits.bench_gate_list(N, DEPTH, np.asarray(us)),
+                           N),
+            jnp.float32)
 
-    run(jprog1)  # compile
-    run(jprog2)
+        def run_k(k):
+            a = circuits.zero_state_canonical(N)
+            t0 = time.perf_counter()
+            for _ in range(k):
+                a = C.execute_plan_chained(a, ops, N)
+            p = float(circuits.prob_top_zero_canonical(a))
+            return time.perf_counter() - t0, p
+    else:
+        from functools import partial
 
-    # min(T2) - min(T1): differencing the per-arm minima (not per-rep
-    # pairs) so relay-latency noise on one arm cannot deflate the estimate
+        def mk(k):
+            @partial(jax.jit, donate_argnums=0)
+            def p(amps, us):
+                prob = None
+                for _ in range(k):
+                    amps = fn(amps, us)
+                    prob = calculations.calc_prob_of_outcome_statevec(
+                        amps, num_qubits=N, target=N - 1, outcome=0
+                    )
+                return amps, prob
+            return p
+
+        progs = {1: mk(1), 2: mk(2)}
+
+        def run_k(k):
+            a = kernels.init_zero_state(1 << N, np.float32)
+            t0 = time.perf_counter()
+            _, p = progs[k](a, us)
+            p = float(p)
+            return time.perf_counter() - t0, p
+
+    # Timing methodology: a device->host fetch through the axon relay
+    # costs ~100 ms and dispatch more — FIXED per-call harness overheads
+    # (a production TPU dispatches in <1 ms).  A single-call wall clock
+    # therefore measures the relay, not the framework.  We K-difference:
+    # T(2 circuits) - T(1 circuit) = pure device time per circuit; both
+    # overheads cancel.  min + spread over REPS reps are reported.
+    t0 = time.perf_counter()
+    _, prob = run_k(1)
+    compile_s = time.perf_counter() - t0
+    run_k(2)
+
     t1s, t2s = [], []
     for _ in range(REPS):
-        t1, prob = run(jprog1)
-        t2, _ = run(jprog2)
+        t1, prob = run_k(1)
+        t2, _ = run_k(2)
         t1s.append(t1)
         t2s.append(t2)
     wall = min(t1s)
@@ -138,6 +126,7 @@ def main():
         f"non-positive K-diff ({best:.4f}s): relay noise exceeded device "
         f"time; raise QT_BENCH_REPS (t1s={t1s}, t2s={t2s})"
     )
+    spread = (max(t2s) - min(t2s)) + (max(t1s) - min(t1s))
 
     value = num_gates * float(1 << N) / best
     # the reference constant was measured at the 26q depth-20 shape; a
@@ -152,11 +141,14 @@ def main():
                 "vs_baseline": (value / BASELINE_AMPS_PER_SEC
                                 if baseline_shape else None),
                 "seconds": best,
+                "seconds_spread": round(spread, 4),
                 "wall_seconds_single_call": wall,
-                "timing": "K-diff (T[2x]-T[1x]; removes ~150ms fixed relay fetch+dispatch overhead)",
+                "compile_plus_first_run_s": round(compile_s, 1),
+                "reps": REPS,
+                "timing": "K-diff (min T[2x] - min T[1x] over reps; removes fixed relay fetch+dispatch overhead)",
                 "gates": num_gates,
                 "backend": jax.default_backend(),
-                "fused": FUSED,
+                "mode": "chained" if FUSED else "per-gate",
                 "prob_check": float(prob),
             }
         )

@@ -132,6 +132,12 @@ def mixDephasing(qureg: Qureg, targetQubit: int, prob: float) -> None:
     V.validate_density_matrix(qureg, "mixDephasing")
     V.validate_target(qureg, targetQubit, "mixDephasing")
     V.validate_one_qubit_dephase_prob(prob, "mixDephasing")
+    from .ops import gatedefs as G
+    if _capture_channel(
+            qureg,
+            [math.sqrt(1 - prob) * G.PAULI_I, math.sqrt(prob) * G.PAULI_Z],
+            (targetQubit,)):
+        return
     qureg.amps = D.mix_dephasing(
         qureg.amps, prob, num_qubits=qureg.num_qubits_represented, target=targetQubit
     )
@@ -142,6 +148,15 @@ def mixTwoQubitDephasing(qureg: Qureg, qubit1: int, qubit2: int, prob: float) ->
     V.validate_density_matrix(qureg, "mixTwoQubitDephasing")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDephasing")
     V.validate_two_qubit_dephase_prob(prob, "mixTwoQubitDephasing")
+    from .ops import gatedefs as G
+    i2, z = np.asarray(G.PAULI_I), np.asarray(G.PAULI_Z)
+    # Kraus order (q2 (x) q1): matrix bit 0 = qubit1
+    ops = [math.sqrt(1 - prob) * np.kron(i2, i2),
+           math.sqrt(prob / 3) * np.kron(i2, z),
+           math.sqrt(prob / 3) * np.kron(z, i2),
+           math.sqrt(prob / 3) * np.kron(z, z)]
+    if _capture_channel(qureg, ops, (qubit1, qubit2)):
+        return
     qureg.amps = D.mix_two_qubit_dephasing(
         qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
         qubit1=qubit1, qubit2=qubit2,
@@ -149,25 +164,89 @@ def mixTwoQubitDephasing(qureg: Qureg, qubit1: int, qubit2: int, prob: float) ->
 
 
 def _mix_kraus(qureg: Qureg, ops, targets) -> None:
+    """Apply a Kraus channel: under gateFusion the superoperator is
+    CAPTURED into the drain as a dense gate on (T, T+n) — noise channels
+    then fold into the same window passes as gates (one compiled program
+    for a whole noise layer) — otherwise the generic superoperator kernel
+    runs eagerly (QuEST_common.c:630-652)."""
+    if _capture_channel(qureg, ops, targets):
+        return
     qureg.amps = D.apply_kraus_map(
         qureg.amps, ops, num_qubits=qureg.num_qubits_represented, targets=tuple(targets)
     )
 
 
+def _capture_channel(qureg: Qureg, ops, targets) -> bool:
+    from . import fusion
+    from .ops import cplx as CX
+
+    if getattr(qureg, "_fusion", None) is None:
+        return False
+    sup = D.superoperator_from_kraus(ops)
+    sv_targets = D.kraus_targets(tuple(targets), qureg.num_qubits_represented)
+    dt = np.float64 if qureg.amps.dtype == jnp.float64 else np.float32
+    return fusion.capture_raw(qureg, CX.soa(sup).astype(dt), sv_targets)
+
+
+def _pair_channel_sharded(qureg: Qureg, prob: float, target: int,
+                          kind: str) -> bool:
+    """Explicit ppermute path for depolarise/damping when the bra target
+    bit is a mesh-coordinate bit (dist.mix_pair_channel_sharded)."""
+    from .parallel import dist as PAR
+
+    env = qureg.env
+    if (env.mesh is None or not PAR.explicit_dist_enabled()
+            or PAR.amp_axis_size(env.mesh) <= 1
+            or qureg.num_amps_total < env.num_devices):
+        return False
+    nq = qureg.num_qubits_represented
+    nloc = 2 * nq - PAR.num_shard_bits(env.mesh)
+    if target + nq < nloc:
+        return False
+    qureg.amps = PAR.mix_pair_channel_sharded(
+        qureg.amps, prob, mesh=env.mesh, num_qubits=nq, target=target,
+        kind=kind)
+    return True
+
+
 def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
-    """One-qubit depolarising channel (QuEST.h:3496)."""
+    """One-qubit depolarising channel (QuEST.h:3496).  Routed, in order:
+    fusion capture (superoperator folds into the drain's window passes) ->
+    explicit ppermute pair-exchange for sharded bra bits -> the dedicated
+    elementwise pair-average kernel (ref QuEST_cpu.c:125-246), never the
+    16x generic superoperator."""
     V.validate_density_matrix(qureg, "mixDepolarising")
     V.validate_target(qureg, targetQubit, "mixDepolarising")
     V.validate_one_qubit_depol_prob(prob, "mixDepolarising")
-    _mix_kraus(qureg, D.depolarising_kraus(prob, qureg.dtype), (targetQubit,))
+    # NOT captured into the drain: the depolarising superoperator has
+    # operator-Schmidt rank 4 across (t | t+n), so a captured fold costs a
+    # rank-4 pass per channel (~18 ms at 2^26) where the elementwise
+    # kernel is one cheap pass (measured: fused 0.60 s vs eager 0.41 s
+    # for config 4's noise block) — but order must be preserved, so any
+    # pending fused gates drain first
+    from . import fusion
+    fusion.drain(qureg)
+    if _pair_channel_sharded(qureg, prob, targetQubit, "depol"):
+        return
+    qureg.amps = D.mix_depolarising(
+        qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
+        target=targetQubit)
 
 
 def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
-    """One-qubit amplitude damping channel (QuEST.h:3534)."""
+    """One-qubit amplitude damping channel (QuEST.h:3534).  Same routing
+    as mixDepolarising (ref elementwise form QuEST_cpu.c:300-385)."""
     V.validate_density_matrix(qureg, "mixDamping")
     V.validate_target(qureg, targetQubit, "mixDamping")
     V.validate_one_qubit_damping_prob(prob, "mixDamping")
-    _mix_kraus(qureg, D.damping_kraus(prob, qureg.dtype), (targetQubit,))
+    # not captured — see mixDepolarising (rank-4 superoperator fold)
+    from . import fusion
+    fusion.drain(qureg)
+    if _pair_channel_sharded(qureg, prob, targetQubit, "damping"):
+        return
+    qureg.amps = D.mix_damping(
+        qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
+        target=targetQubit)
 
 
 def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
@@ -319,6 +398,24 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     return float(C.calc_hilbert_schmidt_distance(a.amps, b.amps))
 
 
+def _sharded_tpu_register(qureg: Qureg) -> bool:
+    """True when the register's amplitude axis actually spans a multi-chip
+    TPU mesh.  The scan-based Trotter/expectation paths run their product
+    layers through raw Pallas window kernels, which have no GSPMD
+    partitioning rule — on a real sharded TPU register those paths must
+    fall back to the per-term kernels (mirrors the _qft_fused guard; the
+    virtual CPU mesh is fine because kernels run in interpret mode there,
+    partitioning as plain XLA ops)."""
+    import jax as _jax
+
+    from .parallel import dist as PAR
+
+    env = qureg.env
+    return (_jax.default_backend() == "tpu" and env.mesh is not None
+            and PAR.amp_axis_size(env.mesh) > 1
+            and qureg.num_amps_total >= env.num_devices)
+
+
 def _full_codes(qureg, targets, codes) -> tuple:
     n = qureg.num_qubits_represented
     full = [PAULI_I] * n
@@ -362,6 +459,13 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
     if qureg.is_density_matrix:
         val = P.calc_expec_pauli_sum_density(
             qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
+        )
+    elif _sharded_tpu_register(qureg):
+        # per-term path: the scan's Pallas product layers cannot partition
+        # under GSPMD on a real multi-chip mesh (see _sharded_tpu_register)
+        val = P.calc_expec_pauli_sum_statevec(
+            qureg.amps, cj, num_qubits=n, codes_flat=codes,
+            num_terms=num_terms,
         )
     else:
         # scan over the term table: one compiled body regardless of term
@@ -500,7 +604,7 @@ def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float, order: int
     if time == 0:
         return
     seq = _trotter_schedule(hamil.num_sum_terms, time, order, reps)
-    if qureg.qasm_log.is_logging:
+    if qureg.qasm_log.is_logging or _sharded_tpu_register(qureg):
         # per-term path so every rotation is QASM-logged.  NOTE:
         # deliberately NOT wrapped in fusion.gate_fusion — the per-term
         # parity phase forces a drain every ~36 rotations, and the
@@ -617,7 +721,9 @@ def applyPhaseFuncOverrides(qureg: Qureg, qubits, encoding, coeffs, exponents, o
         inds, phases,
         num_qubits=_sv_n(qureg), qubits=tuple(qubits), encoding=int(encoding),
     )
-    qureg.qasm_log.comment("here a phase function was applied")
+    qureg.qasm_log.phase_func(
+        qubits, int(encoding), list(np.asarray(coeffs, np.float64).ravel()),
+        list(np.asarray(exponents, np.float64).ravel()), inds, phases)
 
 
 def applyMultiVarPhaseFunc(qureg: Qureg, qubits, numQubitsPerReg, encoding, coeffs, exponents, numTermsPerReg) -> None:
@@ -663,7 +769,9 @@ def applyMultiVarPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, co
         num_qubits=_sv_n(qureg), reg_qubits=regs, encoding=int(encoding),
         terms_per_reg=tuple(int(t) for t in numTermsPerReg),
     )
-    qureg.qasm_log.comment("here a multi-variable phase function was applied")
+    qureg.qasm_log.multi_var_phase_func(
+        regs, int(encoding), list(np.asarray(coeffs, np.float64).ravel()),
+        list(exps.ravel()), [int(t) for t in numTermsPerReg], inds, phases)
 
 
 def applyNamedPhaseFunc(qureg, qubits, numQubitsPerReg, encoding, functionNameCode) -> None:
@@ -709,7 +817,10 @@ def applyParamNamedPhaseFuncOverrides(qureg, qubits, numQubitsPerReg, encoding, 
         num_qubits=_sv_n(qureg), reg_qubits=regs, encoding=int(encoding),
         func_name=int(functionNameCode), conj=_conj,
     )
-    qureg.qasm_log.comment("here a named phase function was applied")
+    qureg.qasm_log.named_phase_func(
+        regs, int(encoding), int(functionNameCode),
+        [] if params is None else list(np.asarray(params, np.float64).ravel()),
+        inds, phases)
 
 
 # ---------------------------------------------------------------------------
@@ -769,16 +880,16 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     contiguous ascending run starting at 0 or >= 7 and the state vector is
     window-sized; otherwise returns False and the layered path runs.
 
-    Sharded registers run the same program under GSPMD: the ladder passes
-    partition on the leading (mesh) bits, layers targeting mesh-coordinate
-    qubits and the final bit-reversal lower to collective-permute /
-    all-to-all over the amplitude axis (collective emission is asserted by
-    tests/test_distributed_hlo.py; correctness vs the DFT oracle by
-    tests/test_distributed.py).  EXCEPT on a real multi-chip TPU mesh:
-    there the winfused ops would put a raw pallas_call under GSPMD, which
-    has no partitioning rule (the CPU mesh runs the kernel bodies in
-    interpret mode, which partitions as plain XLA ops) — those registers
-    take the layered path until a shard_map-wrapped drain covers QFT."""
+    Sharded registers: a FULL-register statevector QFT runs as ONE
+    explicit shard_map program (dist.fused_qft_sharded — ppermute H
+    exchanges for mesh-bit layers, the same Pallas ladder kernels
+    per-shard for local layers, and an all_to_all bit-reversal), so the
+    fused kernel set now runs on real TPU meshes too
+    (QuEST_internal.h:63-292 one-kernel-set contract).  Partial-run or
+    density QFTs on a sharded register ride GSPMD on the virtual CPU
+    mesh (interpret-mode kernels partition as plain XLA ops) and take
+    the layered path on a real multi-chip TPU mesh (a raw pallas_call
+    has no GSPMD partitioning rule)."""
     import jax as _jax
 
     from quest_tpu import circuit as CIRC
@@ -788,10 +899,6 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     if nsv < CIRC.WINDOW:
         return False
     env = qureg.env
-    if (_jax.default_backend() == "tpu" and env.mesh is not None
-            and PAR.amp_axis_size(env.mesh) > 1
-            and qureg.num_amps_total >= env.num_devices):
-        return False
     nt = len(qubits)
     start = qubits[0]
     if list(qubits) != list(range(start, start + nt)):
@@ -799,10 +906,27 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     if not (start == 0 or start >= CIRC.LANE):
         return False
 
+    sharded = (env.mesh is not None and PAR.amp_axis_size(env.mesh) > 1
+               and qureg.num_amps_total >= env.num_devices)
+    if sharded:
+        r = PAR.num_shard_bits(env.mesh)
+        if (not qureg.is_density_matrix and start == 0 and nt == nsv
+                and nsv - r >= r):
+            qureg.amps = PAR.fused_qft_sharded(
+                qureg.amps, mesh=env.mesh, num_qubits=nsv)
+            _qft_qasm_trail(qureg, qubits, nt)
+            return True
+        if _jax.default_backend() == "tpu":
+            return False
+
     shifts = [0, _shift(qureg)] if qureg.is_density_matrix else [0]
     qureg.amps = CIRC.fused_qft(qureg.amps, nsv, start, nt, shifts=shifts)
+    _qft_qasm_trail(qureg, qubits, nt)
+    return True
 
-    # QASM trail mirrors the layered path's record
+
+def _qft_qasm_trail(qureg: Qureg, qubits, nt: int) -> None:
+    """QASM record mirroring the layered path's trail."""
     for q in range(nt - 1, -1, -1):
         qureg.qasm_log.gate("h", (), qubits[q])
         if q:
@@ -810,7 +934,6 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
                 "here a controlled-phase ladder (QFT layer) was applied")
     for i in range(nt // 2):
         qureg.qasm_log.gate("swap", (qubits[i],), qubits[nt - 1 - i])
-    return True
 
 
 # ---------------------------------------------------------------------------

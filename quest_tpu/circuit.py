@@ -325,6 +325,20 @@ def controlled_form_2q(mat_soa):
         for ba in (0, 1):
             idx = (2 * ba + 1) if cb == 0 else (2 + ba)
             d4[idx] = ev[ba]
+        # Verify the decomposition reconstructs the input: the eig + QR
+        # orthonormalization can silently mis-decompose a pathological
+        # near-degenerate or slightly non-unitary V (diag(W^H V W) drops
+        # any off-diagonal residue).  On failure return None so the gate
+        # takes the exact rank-2 Schmidt fold instead.
+        if acted == 0:
+            full_pre = np.kron(np.eye(2), pre)
+            full_post = np.kron(np.eye(2), post)
+        else:
+            full_pre = np.kron(pre, np.eye(2))
+            full_post = np.kron(post, np.eye(2))
+        recon = full_post @ np.diag(d4) @ full_pre
+        if np.abs(recon - u).max() > 16 * tol:
+            continue
         dt = m.dtype
         result = (
             np.stack([pre.real, pre.imag]).astype(dt),
@@ -1087,9 +1101,10 @@ def plan_circuit_windowed(gates: Sequence[Gate],
         # ops/fused.py): its layout differs from the canonical T(8,128)
         # tiling, so XLA inserts full-state retile copies at the pass
         # boundary — measured 5.9 ms vs 1.3 ms per pass at 26q, and an
-        # 8 GB OOM copy at 30q.  Whenever k >= 10 exists (n >= 17), any
-        # gate coverable by k=8/9 is also coverable by k=7 or k >= 10,
-        # so these offsets are never structurally necessary.
+        # 8 GB OOM copy at 30q.  Pruned from the primary candidate set;
+        # the rare gates ONLY these windows cover (targets spanning
+        # exactly bits [8,14] or [9,15]) are caught by the last-resort
+        # retry below — do not delete that fallback.
         if k_hi >= 10:
             cands -= {8, 9}
         best = None

@@ -177,3 +177,57 @@ def bernstein_vazirani_circuit(num_qubits: int, secret: int, dtype=jnp.float32):
     for q in range(n):
         amps = kernels.apply_matrix(amps, _H_SOA, num_qubits=n, targets=(q,))
     return amps
+
+
+# ---------------------------------------------------------------------------
+# Benchmark-workload helpers shared by bench.py / scripts/bench_scale.py
+# (BASELINE.json config 2 shape)
+# ---------------------------------------------------------------------------
+
+CNOT_SOA = np.zeros((2, 4, 4), np.float32)
+CNOT_SOA[0] = np.array(
+    [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], np.float32)
+
+
+def bench_gate_list(num_qubits: int, depth: int, unitaries):
+    """The config-2 gate list (per-layer 1q unitaries + alternating CNOT
+    ladder) as circuit.Gate objects, for the windowed planner.  CNOT
+    convention: control = matrix bit 0 (= targets[0]), target = bit 1."""
+    from .. import circuit as C
+
+    gates = []
+    for d in range(depth):
+        for q in range(num_qubits):
+            gates.append(C.Gate((q,), unitaries[d, q]))
+        for q in range(d % 2, num_qubits - 1, 2):
+            gates.append(C.Gate((q, q + 1), CNOT_SOA))
+    return gates
+
+
+def zero_state_canonical(num_qubits: int):
+    """|0...0> directly in the canonical (2, nb, 128, 128) tiled view,
+    built inside ONE jitted program (an eager zeros + scatter transiently
+    holds two full states — an OOM at 30q)."""
+    return _zero_state_canonical_jit(n=num_qubits)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _zero_state_canonical_jit(*, n):
+    nb = 1 << (n - 14)
+    return jnp.zeros((2, nb, 128, 128), jnp.float32).at[0, 0, 0, 0].set(1.0)
+
+
+@jax.jit
+def prob_top_zero_canonical(a):
+    """P(top qubit = 0) on the canonical view: a contiguous half-slice
+    sum — layout-preserving (calc_prob's generic reshape would re-tile
+    the canonical layout into an 8 GB temp at 30q)."""
+    h = a[:, : a.shape[1] // 2]
+    return jnp.sum(h * h)
+
+
+@jax.jit
+def amp00_canonical(a):
+    """Layout-preserving scalar sync on the canonical view (a gather-style
+    a[0,0,0,0] makes XLA relayout the whole state)."""
+    return jnp.sum(a[:1, :1, :1, :1])

@@ -549,9 +549,10 @@ int qts_plan_windowed(int64_t n, int64_t num_gates, const int64_t* offsets,
             for (int64_t k = lo; k <= hi; ++k) {
               // k in {8, 9} forces the collapsed 4-d state view whose
               // layout breaks the canonical tiling (full-state retile
-              // copies at pass boundaries; OOM at 30q) — never
-              // structurally necessary once k >= 10 exists.  Mirrors
-              // circuit.plan_circuit_windowed.
+              // copies at pass boundaries; OOM at 30q) — pruned here;
+              // gates ONLY those windows cover (spanning exactly bits
+              // [8,14] / [9,15]) are caught by the last-resort retry
+              // below.  Mirrors circuit.plan_circuit_windowed.
               if (k_hi >= 10 && (k == 8 || k == 9)) continue;
               if (std::find(cands.begin(), cands.end(), k) == cands.end())
                 cands.push_back(k);

@@ -97,8 +97,8 @@ def _sigma_swap_jit(amps, ctab, dtab, *, num_qubits: int, group_bits: int,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, npairs),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.VMEM((2, G, G), view.dtype),
             pltpu.VMEM((2, G, G), view.dtype),

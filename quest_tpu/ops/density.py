@@ -95,6 +95,84 @@ def mix_two_qubit_dephasing(amps, prob, *, num_qubits: int, qubit1: int, qubit2:
     return (view * factor[None]).reshape(2, -1)
 
 
+def _pair_channel(amps, nn: int, t: int, b: int, w_same0, w_same1, w_diff,
+                  w2_00, w2_11):
+    """out = w1(kt,bt) * rho + w2(kt,bt) * partner, partner = the element
+    with BOTH target bits flipped.  Weights by block: w1 = w_same0 at
+    (0,0), w_same1 at (1,1), w_diff off-diagonal; w2 = w2_00 at (0,0),
+    w2_11 at (1,1), 0 off-diagonal.  Layout-safe at any size: small
+    states use the interleaved axis view; big states combine the
+    flipped-copy kernel (_flip_bits_flat, never a small-minor flip) with
+    iota-bit indicator weights on the (2^hi, 2^lo) view."""
+    from . import kernels as K
+
+    dt = amps.dtype
+    if nn < K._BIG_N:
+        shape = (2, 1 << (nn - 1 - b), 2, 1 << (b - 1 - t), 2, 1 << t)
+        v = amps.reshape(shape)
+        part = jnp.flip(jnp.flip(v, axis=2), axis=4)
+        def tab(a00, a01, a10, a11):
+            return jnp.stack([jnp.stack([a00, a01]),
+                              jnp.stack([a10, a11])]).reshape(1, 1, 2, 1, 2, 1)
+        one = jnp.ones((), dt)
+        w1 = tab(w_same0, w_diff, w_diff, w_same1)
+        w2 = tab(w2_00, one * 0, one * 0, w2_11)
+        return (v * w1 + part * w2).reshape(amps.shape)
+    part = K._flip_bits_flat(amps.reshape(2, -1), nn, (t, b))
+    kt = K.bit_2d(nn, t).astype(dt)
+    bt = K.bit_2d(nn, b).astype(dt)
+    same = 1 - (kt - bt) * (kt - bt)     # 1 where kt == bt
+    k1b1 = kt * bt
+    k0b0 = same - k1b1
+    w1 = w_diff + (w_same0 - w_diff) * k0b0 + (w_same1 - w_diff) * k1b1
+    w2 = w2_00 * k0b0 + w2_11 * k1b1
+    hi, lo = K._split2(nn)
+    v = amps.reshape(2, 1 << hi, 1 << lo)
+    pv = part.reshape(2, 1 << hi, 1 << lo)
+    return (v * w1[None] + pv * w2[None]).reshape(amps.shape)
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "target"), donate_argnums=0)
+def mix_depolarising(amps, prob, *, num_qubits: int, target: int):
+    """rho -> (1-p) rho + p/3 (X rho X + Y rho Y + Z rho Z) as ONE
+    elementwise pass over the double-flip partner pairing
+
+        rho'[ket bit == bra bit]  = (1-2p/3) rho + (2p/3) partner
+        rho'[ket bit != bra bit]  = (1-4p/3) rho
+
+    — the dedicated pair-average kernel form of the reference
+    (densmatr_mixDepolarisingLocal, QuEST_cpu.c:125-246), replacing the
+    16x-element generic superoperator for this channel."""
+    n = num_qubits
+    nn = 2 * n
+    p = jnp.asarray(prob, amps.dtype)
+    one = jnp.ones((), amps.dtype)
+    return _pair_channel(amps, nn, target, target + n,
+                         w_same0=1 - 2 * p / 3, w_same1=1 - 2 * p / 3,
+                         w_diff=1 - 4 * p / 3,
+                         w2_00=2 * p / 3 * one, w2_11=2 * p / 3 * one)
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "target"), donate_argnums=0)
+def mix_damping(amps, prob, *, num_qubits: int, target: int):
+    """Amplitude damping as ONE elementwise pass (densmatr_mixDampingLocal,
+    QuEST_cpu.c:300-385): population flows |11> -> |00| while coherences
+    scale by sqrt(1-p):
+
+        rho'[0,0] = rho[0,0] + p * partner   (partner = the |11> element)
+        rho'[0,1] = rho'[1,0] = sqrt(1-p) rho
+        rho'[1,1] = (1-p) rho
+    """
+    n = num_qubits
+    nn = 2 * n
+    p = jnp.asarray(prob, amps.dtype)
+    s = jnp.sqrt(1 - p)
+    one = jnp.ones((), amps.dtype)
+    return _pair_channel(amps, nn, target, target + n,
+                         w_same0=one, w_same1=1 - p, w_diff=s,
+                         w2_00=p * one, w2_11=0 * one)
+
+
 def depolarising_kraus(prob, dtype=None):
     """{sqrt(1-p) I, sqrt(p/3) X, sqrt(p/3) Y, sqrt(p/3) Z}
     (mixDepolarising definition, QuEST.h:3496)."""

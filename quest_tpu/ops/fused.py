@@ -311,23 +311,46 @@ def _window_kernel(rank, apply_a, apply_b, prec=jax.lax.Precision.HIGHEST,
             -1, CLUSTER_DIM,
         )                               # (2, R, 128, M, 128)
         xr, xi = x[0], x[1]
-        xc0 = jnp.concatenate([xr, xi], axis=-1)         # (R, 128, M, 256)
-        acc = None
-        for r in range(rank):
-            if apply_a:
+        if apply_a and apply_b:
+            # both sides: the lane-concat real rep keeps each side ONE
+            # 256-contraction (beats 4 separate 128-dots per side,
+            # measured both rounds)
+            xc0 = jnp.concatenate([xr, xi], axis=-1)     # (R, 128, M, 256)
+            acc = None
+            for r in range(rank):
                 xc = _kdot(xc0, ma_ref[r], (((3,), (0,)), ((), ())), prec)                                        # (R, 128, M, 256)
-            else:
-                xc = xc0
-            yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
-            # sublane op: left-contract the window axis (dim 1)
-            yc = jnp.concatenate([yr, yi], axis=1)       # (R, 256, M, 128)
-            if apply_b:
+                yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+                # sublane op: left-contract the window axis (dim 1)
+                yc = jnp.concatenate([yr, yi], axis=1)   # (R, 256, M, 128)
                 out = _kdot(mb_ref[r], yc, (((1,), (1,)), ((), ())), prec)                                        # (256, R, M, 128)
                 out = jnp.moveaxis(out, 0, 1)            # (R, 256, M, 128)
-            else:
-                out = yc
-            acc = out if acc is None else acc + out
-        rr, ri = acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]
+                acc = out if acc is None else acc + out
+            rr, ri = acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]
+        elif apply_b:
+            # B-only: separate-channel dots — skips the lane concat AND
+            # the lane slice the generic path paid for nothing
+            # (measured ~20-30% faster per pass at 26q)
+            rr = ri = None
+            for r in range(rank):
+                br, bi = mb_ref[r, 0], mb_ref[r, 1]
+                db = (((1,), (1,)), ((), ()))
+                pr = _kdot(br, xr, db, prec) - _kdot(bi, xi, db, prec)
+                pi = _kdot(br, xi, db, prec) + _kdot(bi, xr, db, prec)
+                pr = jnp.moveaxis(pr, 0, 1)              # (R, 128, M, 128)
+                pi = jnp.moveaxis(pi, 0, 1)
+                rr = pr if rr is None else rr + pr
+                ri = pi if ri is None else ri + pi
+        else:
+            # A-only: separate-channel right-dots on the lane axis
+            # (y[l'] = sum_l A[l',l] x[l] -> contract the matrix's col dim)
+            rr = ri = None
+            for r in range(rank):
+                ar, ai = ma_ref[r, 0], ma_ref[r, 1]
+                da = (((3,), (1,)), ((), ()))
+                pr = _kdot(xr, ar, da, prec) - _kdot(xi, ai, da, prec)
+                pi = _kdot(xr, ai, da, prec) + _kdot(xi, ar, da, prec)
+                rr = pr if rr is None else rr + pr
+                ri = pi if ri is None else ri + pi
         if with_mask:
             mr = mask_ref[0][:, None, :]                 # (128, 1, 128)
             mi = mask_ref[1][:, None, :]
@@ -391,11 +414,18 @@ def _apply_window_stack_jit(
     # (17.0M) but fits at 8; rank-1 B-only fits at 16 (fewer temporaries
     # with the lane matmul skipped).
     block_amps = max(BLOCK_AMPS, 2 * block_amps // rank)
-    if rank == 1 and apply_a:
-        # 16 blocks with the lane matmul live sits right at the 16M scoped
-        # VMEM limit — it compiled in one program and overflowed (17.0M)
-        # in another for the SAME kernel config, so stay safely at 8;
-        # B-only passes (no lane matmul) keep 16
+    if n <= 21:
+        # small states (<= 16 MB) can be VMEM-promoted wholesale by XLA
+        # inside larger programs; an 8-block pass then overflows the 16 MB
+        # scoped VMEM (measured 18.55M at n=20).  4 blocks always fit.
+        block_amps = min(block_amps, 4 * BLOCK_AMPS)
+    if rank == 1 and (apply_a == apply_b or mask is not None):
+        # 16 blocks sit at/over the 16M scoped VMEM limit when extra
+        # temporaries are live: the dual-side kernel overflowed at 17.0M
+        # with the lane matmul, and the separate-channel single-side
+        # kernels overflowed at 25.8M when the mask multiply is added —
+        # those cases stay safely at 8; unmasked single-side passes keep
+        # 16 (fewer temporaries, measured faster)
         block_amps = min(block_amps, 8 * BLOCK_AMPS)
     # View choice is LAYOUT-critical: with mid >= 8 the 5-d view
     # (2, hi, 128, mid, 128) under the default T(8,128) tiling of its two
@@ -419,8 +449,17 @@ def _apply_window_stack_jit(
     R = min(hi, max(1, block_amps // (M * BLOCK_AMPS)))
     while hi % R:
         R //= 2
-    ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
-    mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
+    if apply_a and apply_b:
+        # dual-side kernel consumes the 256x256 real representations
+        ma = jax.vmap(lane_real_rep)(jnp.asarray(mats_a, amps.dtype))
+        mb = jax.vmap(sublane_real_rep)(jnp.asarray(mats_b, amps.dtype))
+        mat_dim = 2 * CLUSTER_DIM
+        mat_spec = (rank, mat_dim, mat_dim)
+    else:
+        # single-side kernels consume the raw SoA matrices
+        ma = jnp.asarray(mats_a, amps.dtype)
+        mb = jnp.asarray(mats_b, amps.dtype)
+        mat_spec = (rank, 2, CLUSTER_DIM, CLUSTER_DIM)
     with_mask = mask is not None
     if five_d:
         view = amps.reshape(2, hi, CLUSTER_DIM, mid, CLUSTER_DIM)
@@ -430,12 +469,11 @@ def _apply_window_stack_jit(
         view = amps.reshape(2, hi, CLUSTER_DIM, mid * CLUSTER_DIM)
         state_spec = pl.BlockSpec((2, R, CLUSTER_DIM, M * CLUSTER_DIM),
                                   lambda i, j: (0, i, 0, j))
+    zmap = (lambda i, j: (0,) * len(mat_spec))
     in_specs = [
         state_spec,
-        pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                     lambda i, j: (0, 0, 0)),
-        pl.BlockSpec((rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM),
-                     lambda i, j: (0, 0, 0)),
+        pl.BlockSpec(mat_spec, zmap),
+        pl.BlockSpec(mat_spec, zmap),
     ]
     operands = [view, ma, mb]
     if with_mask:

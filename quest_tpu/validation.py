@@ -129,13 +129,29 @@ def _raise(code: str, func: str, *fmt):
     raise QuESTError(f"{func}: {msg}")
 
 
+def strict_parity() -> bool:
+    """QT_STRICT_VALIDATION=1 escalates the two deliberately-warn-only
+    codes (E_CANNOT_FIT_MULTI_QUBIT_MATRIX, E_DISTRIB_QUREG_TOO_SMALL) to
+    QuESTError so test suites ported verbatim from the reference (which
+    REQUIRE_THROWS_WITH on them) pass unchanged.  By default they warn:
+    quest_tpu can actually execute both cases (SWAP-relocalization /
+    mesh replication) where the reference must reject them."""
+    import os
+
+    return os.environ.get("QT_STRICT_VALIDATION") == "1"
+
+
 def _warn(code: str, func: str):
+    if strict_parity():
+        _raise(code, func)
     warnings.warn(f"{func}: {ERROR_MESSAGES[code]} "
                   "(quest_tpu executes this via SWAP-relocalization instead "
                   "of rejecting it)", stacklevel=3)
 
 
 def _warn_replicated(code: str, func: str):
+    if strict_parity():
+        _raise(code, func)
     warnings.warn(f"{func}: {ERROR_MESSAGES[code]} "
                   "(quest_tpu replicates such small registers across the "
                   "mesh instead of rejecting them)", stacklevel=3)

@@ -50,46 +50,11 @@ def fetch_overhead():
     return (time.perf_counter() - t0) / 5
 
 
-@partial(jax.jit, static_argnames=("n",))
-def _zero_canonical_jit(*, n):
-    # one program: zeros + set fuse into a single 8 GB buffer (the eager
-    # .at[].set() form transiently held TWO full states -> 30q OOM)
-    nb = 1 << (n - 14)
-    return jnp.zeros((2, nb, 128, 128), jnp.float32).at[0, 0, 0, 0].set(1.0)
-
-
-def _zero_canonical(n):
-    return _zero_canonical_jit(n=n)
-
-
-@jax.jit
-def _amp00(a):
-    # layout-preserving scalar sync: an eager (or gather-style jitted)
-    # a[0,0,0,0] makes XLA relayout the whole 8 GB state at 30q -> OOM;
-    # a contiguous one-tile slice reduction keeps the canonical layout
-    return jnp.sum(a[:1, :1, :1, :1])
-
-
-@jax.jit
-def _prob_top_zero(a):
-    # P(top qubit = 0) on the canonical view: contiguous half-slice sum —
-    # no reshape, no full-state temp (calc_prob's internal (2, hi, lo)
-    # reshape re-tiles the canonical layout: an 8 GB temp at 30q)
-    h = a[:, : a.shape[1] // 2]
-    return jnp.sum(h * h)
-
-
-def build_gates(n, depth, us):
-    cnot = np.zeros((2, 4, 4), np.float32)
-    cnot[0] = np.array(
-        [[1, 0, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0], [0, 1, 0, 0]], np.float32)
-    gates = []
-    for d in range(depth):
-        for q in range(n):
-            gates.append(C.Gate((q,), us[d, q]))
-        for q in range(d % 2, n - 1, 2):
-            gates.append(C.Gate((q, q + 1), cnot))
-    return gates
+# shared canonical-view helpers live in quest_tpu.models.circuits
+_zero_canonical = circuits.zero_state_canonical
+_amp00 = circuits.amp00_canonical
+_prob_top_zero = circuits.prob_top_zero_canonical
+build_gates = circuits.bench_gate_list
 
 
 def run_random(n, depth=20):

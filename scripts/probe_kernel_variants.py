@@ -1,0 +1,158 @@
+"""Probe: window-pass kernel variants at 26q, K-diff timed on the chip.
+
+V0: current concat-based real-rep kernel (fused.apply_window_stack)
+V1: separate-channel kernel — 4 matmuls per side, no concat/slice/stack
+V2: V1 with channel-separate output writes
+V3: masked variants of V0/V1 (mask multiply cost)
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from quest_tpu import circuit as C
+from quest_tpu.ops import fused
+
+N = 26
+HI_PREC = jax.lax.Precision.HIGHEST
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def sep_kernel(apply_a, apply_b, with_mask=False):
+    def kernel(a_ref, ma_ref, mb_ref, *rest):
+        mask_ref, o_ref = (rest[0], rest[1]) if with_mask else (None, rest[0])
+        x = a_ref[...]                       # (2, R, 128, M, 128)
+        xr, xi = x[0], x[1]
+        d_lane = (((2,), (0,)), ((), ()))    # contract lane axis (dim 3 of xr -> after indexing (R,128,M,128): lanes = dim 3)
+        dd = (((3,), (0,)), ((), ()))
+        if apply_a:
+            Ar = ma_ref[0]
+            Ai = ma_ref[1]
+            f = partial(jax.lax.dot_general, dimension_numbers=dd,
+                        precision=HI_PREC, preferred_element_type=jnp.float32)
+            ar = f(xr, Ar) - f(xi, Ai)
+            ai = f(xr, Ai) + f(xi, Ar)
+        else:
+            ar, ai = xr, xi
+        if apply_b:
+            Br = mb_ref[0]
+            Bi = mb_ref[1]
+            db = (((1,), (1,)), ((), ()))    # contract window axis of (R,128,M,128) with B row dim? B[w', w]: contract dim 1
+            g = partial(jax.lax.dot_general, dimension_numbers=db,
+                        precision=HI_PREC, preferred_element_type=jnp.float32)
+            # g(B, y) contracts B dim1 with y dim1 -> out (128w', R, M, 128)
+            orr = g(Br, ar) - g(Bi, ai)
+            oii = g(Br, ai) + g(Bi, ar)
+            orr = jnp.moveaxis(orr, 0, 1)
+            oii = jnp.moveaxis(oii, 0, 1)
+        else:
+            orr, oii = ar, ai
+        if with_mask:
+            mr = mask_ref[0][:, None, :]
+            mi = mask_ref[1][:, None, :]
+            orr, oii = orr * mr - oii * mi, orr * mi + oii * mr
+        o_ref[0] = orr
+        o_ref[1] = oii
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "k", "apply_a", "apply_b",
+                                   "with_mask"),
+         donate_argnums=0)
+def sep_window(amps, ma, mb, mask=None, *, num_qubits, k, apply_a=True,
+               apply_b=True, with_mask=False):
+    n = num_qubits
+    in_shape = amps.shape
+    hi = 1 << (n - k - 7)
+    mid = 1 << (k - 7)
+    M = min(mid, 8 if apply_a else 16)
+    while mid % M:
+        M //= 2
+    R = 1
+    view = amps.reshape(2, hi, 128, mid, 128)
+    state_spec = pl.BlockSpec((2, R, 128, M, 128), lambda i, j: (0, i, 0, j, 0))
+    in_specs = [state_spec,
+                pl.BlockSpec((2, 128, 128), lambda i, j: (0, 0, 0)),
+                pl.BlockSpec((2, 128, 128), lambda i, j: (0, 0, 0))]
+    ops = [view, ma, mb]
+    if with_mask:
+        in_specs.append(pl.BlockSpec((2, 128, 128), lambda i, j: (0, 0, 0)))
+        ops.append(mask)
+    out = pl.pallas_call(
+        sep_kernel(apply_a, apply_b, with_mask),
+        grid=(hi // R, mid // M),
+        in_specs=in_specs,
+        out_specs=state_spec,
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+    )(*ops)
+    return out.reshape(in_shape)
+
+
+def main():
+    log(devices=str(jax.devices()))
+    rng = np.random.default_rng(0)
+
+    def rand_u7():
+        d = 128
+        z = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+        q, r = np.linalg.qr(z)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag]).astype(np.float32)
+
+    a_soa = jnp.asarray(rand_u7())
+    b_soa = jnp.asarray(rand_u7())
+    mask = jnp.asarray(np.stack([np.cos(np.outer(np.arange(128), np.arange(128)) * 1e-3),
+                                 np.sin(np.outer(np.arange(128), np.arange(128)) * 1e-3)]).astype(np.float32))
+    nb = 1 << (N - 14)
+    fresh = lambda: jnp.zeros((2, nb, 128, 128), jnp.float32).at[0, 0, 0, 0].set(1.0)
+
+    # correctness: sep vs current at k=14
+    a1 = fused.apply_window_stack(fresh(), a_soa[None], b_soa[None], num_qubits=N, k=14)
+    a2 = sep_window(fresh(), a_soa, b_soa, num_qubits=N, k=14)
+    d01 = float(jnp.max(jnp.abs(a1 - a2)))
+    m1 = fused.apply_window_stack(fresh(), a_soa[None], b_soa[None], mask, num_qubits=N, k=14)
+    m2 = sep_window(fresh(), a_soa, b_soa, mask, num_qubits=N, k=14, with_mask=True)
+    d02 = float(jnp.max(jnp.abs(m1 - m2)))
+    log(check_AB=d01, check_mask=d02)
+
+    def timer(fn, r1=8, r2=40):
+        def run(reps):
+            a = fresh()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                a = fn(a)
+            s = float(jnp.sum(a[:1, :1, :1, :1]))
+            return time.perf_counter() - t0
+        run(1)
+        t1 = min(run(r1) for _ in range(4))
+        t2 = min(run(r2) for _ in range(4))
+        return (t2 - t1) / (r2 - r1) * 1e3
+
+    cases = {
+        "V0 A+B k=14": lambda a: fused.apply_window_stack(a, a_soa[None], b_soa[None], num_qubits=N, k=14),
+        "V1 sep A+B k=14": lambda a: sep_window(a, a_soa, b_soa, num_qubits=N, k=14),
+        "V0 A+B+mask k=14": lambda a: fused.apply_window_stack(a, a_soa[None], b_soa[None], mask, num_qubits=N, k=14),
+        "V1 sep A+B+mask": lambda a: sep_window(a, a_soa, b_soa, mask, num_qubits=N, k=14, with_mask=True),
+        "V0 B-only k=14": lambda a: fused.apply_window_stack(a, a_soa[None], b_soa[None], num_qubits=N, k=14, apply_a=False),
+        "V1 sep B-only": lambda a: sep_window(a, a_soa, b_soa, num_qubits=N, k=14, apply_a=False),
+        "V1 sep B+mask": lambda a: sep_window(a, a_soa, b_soa, mask, num_qubits=N, k=14, apply_a=False, with_mask=True),
+    }
+    for name, fn in cases.items():
+        log(stage=name, per_pass_ms=round(timer(fn), 2))
+
+
+if __name__ == "__main__":
+    main()

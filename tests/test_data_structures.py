@@ -172,3 +172,36 @@ def test_environment_reporting(env, capsys):
     qt.reportQuregParams(q)
     out = capsys.readouterr().out
     assert "4" in out
+
+
+def test_qasm_phase_func_symbolic_records(env):
+    """Phase functions are recorded as the reference's multi-line symbolic
+    comment blocks (qasm_recordPhaseFunc / ...NamedPhaseFunc,
+    QuEST_qasm.c:490-891): the scalar form, the sub-register symbol lines,
+    override kets, and shift deltas."""
+    q = qt.createQureg(5, env)
+    qt.startRecordingQASM(q)
+    qt.applyPhaseFuncOverrides(q, [0, 3, 2], 0, [0.5, -1.3], [2.0, 4.0],
+                               [0, 1], [0.45, -0.5])
+    qt.applyNamedPhaseFunc(q, [0, 1, 2, 3], [2, 2], 0, 0)      # NORM
+    qt.applyParamNamedPhaseFunc(q, [0, 1, 2, 3], [2, 2], 0, 4,
+                                [-1.0, 0.0, 0.5, -0.2])
+    qt.applyMultiVarPhaseFunc(q, [0, 1, 2, 3], [2, 2], 0,
+                              [0.5, -1.0], [2.0, 1.0], [1, 1])
+    txt = str(q.qasm_log)
+    for frag in (
+        "applyPhaseFunc() multiplied a complex scalar of the form",
+        "exp(i (0.5 x^2 - 1.3 x^4))",
+        "{0, 3, 2}",
+        "though with overrides",
+        "|0> -> exp(i 0.45)",
+        "|1> -> exp(i (-0.5))",
+        "exp(i sqrt(x^2 + y^2))",
+        "|x> = {0, 1}",
+        "|y> = {2, 3}",
+        "with the additional parameters",
+        "delta0 = 0.5",
+        "delta1 = -0.2",
+        "applyMultiVarPhaseFunc() multiplied a complex scalar of the form",
+    ):
+        assert frag in txt, frag

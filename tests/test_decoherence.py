@@ -155,3 +155,38 @@ def test_decoherence_validation(env):
         qt.mixKrausMap(r, 0, [np.eye(2) * 2])
     with pytest.raises(qt.QuESTError, match="cannot exceed the probability of no error"):
         qt.mixPauli(r, 0, 0.5, 0.4, 0.3)
+
+
+def test_channels_captured_under_fusion_match_eager(env):
+    """Inside gateFusion, channels are captured as superoperator gates and
+    folded into the drain's passes; the result must equal the eager
+    per-channel path exactly (same math, different batching)."""
+    import numpy as np
+    import oracle
+
+    n = 4
+    rng = np.random.default_rng(77)
+    mat = oracle.random_density(n, rng)
+
+    def run(fused):
+        r = qt.createDensityQureg(n, env)
+        oracle.set_qureg_from_array(qt, r, mat)
+        def body():
+            qt.hadamard(r, 0)
+            qt.mixDepolarising(r, 1, 0.25)
+            qt.mixDamping(r, 2, 0.4)
+            qt.mixDephasing(r, 0, 0.1)
+            qt.mixTwoQubitDephasing(r, 1, 3, 0.2)
+            qt.controlledNot(r, 0, 3)
+            qt.mixKrausMap(r, 3, [np.sqrt(0.7) * oracle.I2,
+                                  np.sqrt(0.3) * oracle.X])
+        if fused:
+            with qt.gateFusion(r):
+                body()
+        else:
+            body()
+        return oracle.state_from_qureg(r)
+
+    a = run(False)
+    b = run(True)
+    np.testing.assert_allclose(a, b, atol=1e-10)

@@ -308,3 +308,17 @@ class TestPhaseFuncs:
             qt.applyMultiVarPhaseFunc(
                 q, [0, 1], [1, 1], qt.UNSIGNED, [1.0, 1.0], [-1.0, 1.0],
                 [1, 1])
+
+
+def test_strict_parity_mode_escalates_warn_codes(env, monkeypatch):
+    """QT_STRICT_VALIDATION=1 turns the two deliberately-warn-only codes
+    into QuESTError, matching reference REQUIRE_THROWS_WITH suites."""
+    import os
+    import pytest as _pytest
+
+    monkeypatch.setenv("QT_STRICT_VALIDATION", "1")
+    from quest_tpu import validation as V
+    with _pytest.raises(V.QuESTError, match="at least one amplitude per node"):
+        V._warn_replicated("E_DISTRIB_QUREG_TOO_SMALL", "createQureg")
+    with _pytest.raises(V.QuESTError, match="targets too many qubits"):
+        V._warn("E_CANNOT_FIT_MULTI_QUBIT_MATRIX", "multiQubitUnitary")
