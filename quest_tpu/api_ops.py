@@ -195,9 +195,7 @@ def _pair_channel_sharded(qureg: Qureg, prob: float, target: int,
     from .parallel import dist as PAR
 
     env = qureg.env
-    if (env.mesh is None or not PAR.explicit_dist_enabled()
-            or PAR.amp_axis_size(env.mesh) <= 1
-            or qureg.num_amps_total < env.num_devices):
+    if not PAR.explicit_dist_enabled() or not _spans_mesh(qureg):
         return False
     nq = qureg.num_qubits_represented
     nloc = 2 * nq - PAR.num_shard_bits(env.mesh)
@@ -398,22 +396,27 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     return float(C.calc_hilbert_schmidt_distance(a.amps, b.amps))
 
 
-def _sharded_tpu_register(qureg: Qureg) -> bool:
-    """True when the register's amplitude axis actually spans a multi-chip
-    TPU mesh.  The scan-based Trotter/expectation paths run their product
-    layers through raw Pallas window kernels, which have no GSPMD
-    partitioning rule — on a real sharded TPU register those paths must
-    fall back to the per-term kernels (mirrors the _qft_fused guard; the
-    virtual CPU mesh is fine because kernels run in interpret mode there,
-    partitioning as plain XLA ops)."""
-    import jax as _jax
-
+def _spans_mesh(qureg: Qureg) -> bool:
+    """True when the register's amplitude axis actually spans a
+    multi-device mesh (replicated-small registers do not)."""
     from .parallel import dist as PAR
 
     env = qureg.env
-    return (_jax.default_backend() == "tpu" and env.mesh is not None
-            and PAR.amp_axis_size(env.mesh) > 1
+    return (env.mesh is not None and PAR.amp_axis_size(env.mesh) > 1
             and qureg.num_amps_total >= env.num_devices)
+
+
+def _sharded_tpu_register(qureg: Qureg) -> bool:
+    """_spans_mesh AND a real TPU backend.  The scan-based
+    Trotter/expectation paths run their product layers through raw Pallas
+    window kernels, which have no GSPMD partitioning rule — on a real
+    sharded TPU register those paths must fall back to the per-term
+    kernels (mirrors the _qft_fused guard; the virtual CPU mesh is fine
+    because kernels run in interpret mode there, partitioning as plain
+    XLA ops)."""
+    import jax as _jax
+
+    return _jax.default_backend() == "tpu" and _spans_mesh(qureg)
 
 
 def _full_codes(qureg, targets, codes) -> tuple:
@@ -906,8 +909,7 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     if not (start == 0 or start >= CIRC.LANE):
         return False
 
-    sharded = (env.mesh is not None and PAR.amp_axis_size(env.mesh) > 1
-               and qureg.num_amps_total >= env.num_devices)
+    sharded = _spans_mesh(qureg)
     if sharded:
         r = PAR.num_shard_bits(env.mesh)
         if (not qureg.is_density_matrix and start == 0 and nt == nsv

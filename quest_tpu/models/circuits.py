@@ -221,7 +221,10 @@ def _zero_state_canonical_jit(*, n):
 def prob_top_zero_canonical(a):
     """P(top qubit = 0) on the canonical view: a contiguous half-slice
     sum — layout-preserving (calc_prob's generic reshape would re-tile
-    the canonical layout into an 8 GB temp at 30q)."""
+    the canonical layout into an 8 GB temp at 30q).  Needs n >= 15 so
+    the top qubit is a whole slice of the tile axis."""
+    if a.shape[1] < 2:
+        raise ValueError("prob_top_zero_canonical needs >= 2 tiles (n >= 15)")
     h = a[:, : a.shape[1] // 2]
     return jnp.sum(h * h)
 

@@ -419,13 +419,14 @@ def _apply_window_stack_jit(
         # inside larger programs; an 8-block pass then overflows the 16 MB
         # scoped VMEM (measured 18.55M at n=20).  4 blocks always fit.
         block_amps = min(block_amps, 4 * BLOCK_AMPS)
-    if rank == 1 and (apply_a == apply_b or mask is not None):
+    if rank == 1 and (apply_a == apply_b or mask is not None or mid < 8):
         # 16 blocks sit at/over the 16M scoped VMEM limit when extra
         # temporaries are live: the dual-side kernel overflowed at 17.0M
-        # with the lane matmul, and the separate-channel single-side
-        # kernels overflowed at 25.8M when the mask multiply is added —
-        # those cases stay safely at 8; unmasked single-side passes keep
-        # 16 (fewer temporaries, measured faster)
+        # with the lane matmul, the separate-channel single-side kernels
+        # at 25.8M with a mask, and the single-side NON-five_d layout
+        # (mid < 8, e.g. k=7 B-only in the QFT bit reversal) at 19.0M —
+        # all capped at 8.  Only unmasked single-side passes in the 5-d
+        # layout keep 16 (fewer temporaries; compiles at <= 16M).
         block_amps = min(block_amps, 8 * BLOCK_AMPS)
     # View choice is LAYOUT-critical: with mid >= 8 the 5-d view
     # (2, hi, 128, mid, 128) under the default T(8,128) tiling of its two

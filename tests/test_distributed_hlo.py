@@ -243,6 +243,28 @@ class TestPairFamiliesCommunicate:
         assert set(hist) <= {"collective-permute", "all-gather"}, hist
         assert sum(hist.values()) <= 8, hist
 
+    def test_diagonal_op_on_rho_gathers_only_the_op(self, env8):
+        """applyDiagonalOp on a sharded rho replicates the (small) OP
+        vector to every shard — the reference's copyDiagOpIntoMatrixPair-
+        State (QuEST_cpu_distributed.c:1548-1587) — and must NOT gather
+        the state.  Pinned by opcode (all-gathers only, bounded count)
+        AND by gathered size (every all-gather in the HLO is op-sized,
+        2^nq elements, never state-sized 2^2nq)."""
+        nq = 7
+        amps = sharded_state(env8, 2 * nq, 14)
+        op = jax.device_put(jnp.ones((1 << nq,), amps.dtype),
+                            env8.vec_sharding())
+
+        def f(a, re, im):
+            return D.apply_diagonal_op_density(a, re, im, num_qubits=nq)
+
+        hist = collective_ops(f, amps, op, op * 0.5)
+        assert set(hist) == {"all-gather"} and hist["all-gather"] <= 4, hist
+        txt = jax.jit(f).lower(amps, op, op * 0.5).compile().as_text()
+        for line in txt.splitlines():
+            if " all-gather(" in line:
+                assert f"[{1 << nq}]{{" in line, line  # op-sized, ever
+
     def test_api_routes_explicit_channel_on_sharded_rho(self, env8):
         """The API-level routing predicate sends sharded-bra channels to
         the explicit kernel (the audit above pins it at 1 permute)."""
