@@ -31,10 +31,12 @@ _LAST_COMPILE_S = [0.0]
 
 
 def _time_best(fn, reps=3):
-    """(best_seconds, last_result) — result captured so callers never rerun
-    the workload just to log it.  The warm-up (compile + first run) wall is
-    kept in _LAST_COMPILE_S and reported per config (compile cost is a
-    first-class metric for a traced-program framework)."""
+    """(best_seconds, last_result, compile_seconds) — result captured so
+    callers never rerun the workload just to log it; the warm-up (compile +
+    first run) wall is returned AND kept in _LAST_COMPILE_S for _emit
+    (compile cost is a first-class metric for a traced-program
+    framework).  Configs that time several variants pass the compile_s of
+    the variant they report to _emit via _set_compile."""
     t0 = time.perf_counter()
     result = fn()  # warm-up/compile
     _LAST_COMPILE_S[0] = time.perf_counter() - t0
@@ -43,7 +45,11 @@ def _time_best(fn, reps=3):
         t0 = time.perf_counter()
         result = fn()
         best = min(best, time.perf_counter() - t0)
-    return best, result
+    return best, result, _LAST_COMPILE_S[0]
+
+
+def _set_compile(compile_s: float) -> None:
+    _LAST_COMPILE_S[0] = compile_s
 
 
 def _emit(config, metric, value, unit, seconds, extra=None):
@@ -83,8 +89,9 @@ def config1():
                 qt.controlledRotateX(q, t - 1, t, 0.3)
         return qt.calcProbOfOutcome(q, n - 1, 0)
 
-    seconds, prob = _time_best(run)
-    fused_seconds, fused_prob = _time_best(run_fused)
+    seconds, prob, compile_s = _time_best(run)
+    fused_seconds, fused_prob, _ = _time_best(run_fused)
+    _set_compile(compile_s)
     gates = n  # 1 H + (n-1) controlled rotations
     _emit(1, "12q API chain gate rate", gates * (1 << n) / seconds,
           "amp_updates_per_sec", seconds,
@@ -124,7 +131,7 @@ def config3():
         float(np.asarray(out[0, 0]))
         return out
 
-    seconds, _ = _time_best(run)
+    seconds, _, _ = _time_best(run)
     gates = n + n * (n - 1) // 2 + n // 2  # H ladder + CPhase ladder + swaps
     _emit(3, f"{n}q QFT gate rate", gates * (1 << n) / seconds,
           "amp_updates_per_sec", seconds, {"gates": gates})
@@ -160,10 +167,9 @@ def config4():
         qt.initPlusState(psi)
         return qt.calcFidelity(rho, psi)
 
-    seconds, fidelity = _time_best(run)
-    compile_s = _LAST_COMPILE_S[0]   # before the k=2 warm-up clobbers it
-    sec2, _ = _time_best(lambda: run(2))
-    _LAST_COMPILE_S[0] = compile_s
+    seconds, fidelity, compile_s = _time_best(run)
+    sec2, _, _ = _time_best(lambda: run(2))
+    _set_compile(compile_s)
     _emit(4, f"{n}q density noise+fidelity wall-clock", seconds, "seconds",
           seconds, {"fidelity": fidelity,
                     "kdiff_noise_device_s": round(sec2 - seconds, 3)})
@@ -191,7 +197,7 @@ def config5():
         qt.applyTrotterCircuit(psi, hamil, 0.1, 2, 1)
         return e
 
-    seconds, energy = _time_best(run)
+    seconds, energy, _ = _time_best(run)
     _emit(5, f"{n}q PauliHamil expec+Trotter wall-clock", seconds, "seconds",
           seconds, {"energy": energy})
 

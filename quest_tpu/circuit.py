@@ -1330,6 +1330,9 @@ def fused_qft(amps, num_qubits: int, start: int, count: int,
     if not (start == 0 or start >= LANE):
         raise ValueError("fused_qft needs start == 0 or start >= 7")
     dt = np.float64 if amps.dtype == jnp.float64 else np.float32
+    if (start == 0 and tuple(shifts) == (0,) and count >= 15
+            and fused.qft_multilayer_enabled(amps.dtype)):
+        return _fused_qft_multilayer(amps, n, count, interpret)
     dense_gates: List[Gate] = []
     for si, sh in enumerate(shifts):
         conj = si > 0
@@ -1359,6 +1362,45 @@ def fused_qft(amps, num_qubits: int, start: int, count: int,
     return amps
 
 
+def _fused_qft_multilayer(amps, n: int, count: int,
+                          interpret: Optional[bool]):
+    """Radix-2^k QFT (full or [0, count) run of a statevector register):
+
+      * layers t >= 14 in chunks of QT_QFT_RADIX (default 4) per HBM
+        sweep (fused.apply_qft_multi_hi — pair bits co-resident in VMEM,
+        classic high-radix FFT blocking),
+      * ALL seven sublane layers (t = 13..7) as ONE sweep
+        (fused.apply_qft_cluster_multi),
+      * the seven lane layers (t = 6..0) FOLDED with the lane+sublane
+        within-group bit reversals into a single dense window pass,
+      * then only the high-group reversal passes and the group-order
+        permute remain from bit_reversal_ops(skip_low_group=True) — the
+        merged lane+sublane reversal pass it would normally emit first is
+        the fold above.
+
+    Pass count at 26q: 3 + 1 + 1 + 3 = 8 vs the per-layer path's 24; the
+    reference's per-gate dispatch is ~2.5n sweeps (agnostic_applyQFT,
+    QuEST_common.c:836-898)."""
+    dt = np.float64 if amps.dtype == jnp.float64 else np.float32
+    K = fused._qft_radix()
+    t = count - 1
+    while t >= WINDOW:
+        t_lo = max(WINDOW, t - K + 1)
+        amps = fused.apply_qft_multi_hi(
+            amps, num_qubits=n, t_hi=t, t_lo=t_lo, interpret=interpret)
+        t = t_lo - 1
+    amps = fused.apply_qft_cluster_multi(
+        amps, num_qubits=n, interpret=interpret)
+    dense_gates = [Gate(tuple(range(qq + 1)), _qft_layer_dense(qq, False, dt))
+                   for qq in range(LANE - 1, -1, -1)]
+    rev7 = _rev_perm_mat(LANE, dt)
+    dense_gates.append(Gate(tuple(range(LANE)), rev7))
+    dense_gates.append(Gate(tuple(range(LANE, 2 * LANE)), rev7))
+    ops = plan_circuit(dense_gates, n)
+    rev_ops = bit_reversal_ops(n, [(0, count)], dt, skip_low_group=True)
+    return execute_plan(amps, list(ops) + rev_ops, n, interpret=interpret)
+
+
 # ---------------------------------------------------------------------------
 # Fast bit reversal: group decomposition instead of one all-axes transpose
 # ---------------------------------------------------------------------------
@@ -1377,7 +1419,7 @@ def _rev_perm_mat(bits: int, dt, off: int = 0) -> np.ndarray:
     return np.stack([m, np.zeros((d, d))]).astype(dt)
 
 
-def _bit_reversal_big(n: int, dt) -> List[tuple]:
+def _bit_reversal_big(n: int, dt, skip_low_group: bool = False) -> List[tuple]:
     """Bit reversal of the FULL state without any out-of-place transpose:
     rev[0,n) = (within-group reversals, in-place window passes) o sigma
     for the palindromic group split (7, 7, n-28, 7, 7), where sigma (swap
@@ -1389,7 +1431,8 @@ def _bit_reversal_big(n: int, dt) -> List[tuple]:
     ops: List[tuple] = []
     rev7 = jnp.asarray(_rev_perm_mat(LANE, dt))
     eye = jnp.asarray(_eye_cluster(), rev7.dtype)
-    ops.append(("winfused", LANE, rev7[None], rev7[None], True, True))
+    if not skip_low_group:
+        ops.append(("winfused", LANE, rev7[None], rev7[None], True, True))
     if r:
         m = jnp.asarray(_rev_perm_mat(r, dt, off=0))
         ops.append(("winfused", WINDOW, eye[None], m[None], False, True))
@@ -1400,7 +1443,8 @@ def _bit_reversal_big(n: int, dt) -> List[tuple]:
 
 
 def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
-                     dt) -> Optional[List[tuple]]:
+                     dt, skip_low_group: bool = False
+                     ) -> Optional[List[tuple]]:
     """Ops reversing the qubit order of each contiguous run
     (start, count), or None when no fast decomposition applies.
 
@@ -1415,11 +1459,19 @@ def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
 
     Full-state runs at n >= 30 take the in-place palindromic path
     instead (_bit_reversal_big): the XLA transpose needs a second
-    full-state buffer, which no longer fits in HBM there."""
+    full-state buffer, which no longer fits in HBM there.
+
+    ``skip_low_group=True`` omits the merged lane+sublane within-group
+    reversal pass (the caller folds those two rev7 matrices into its own
+    dense window pass — circuit._fused_qft_multilayer); it requires a
+    single run starting at 0 with two full 7-bit low groups."""
+    if skip_low_group and not (
+            len(runs) == 1 and runs[0][0] == 0 and runs[0][1] >= 14):
+        raise ValueError("skip_low_group needs one run = (0, count >= 14)")
     if (len(runs) == 1 and runs[0] == (0, n) and 30 <= n < 35
             and np.dtype(dt) == np.float32
             and not fused._interpret_default()):
-        return _bit_reversal_big(n, dt)
+        return _bit_reversal_big(n, dt, skip_low_group=skip_low_group)
     ops: List[tuple] = []
     perm = list(range(n))
     eye = None
@@ -1438,15 +1490,19 @@ def bit_reversal_ops(n: int, runs: Sequence[Tuple[int, int]],
         # second group's window pass when both exist)
         i0 = 0
         if groups[0][0] == 0:
-            a_mat = jnp.asarray(_rev_perm_mat(groups[0][1], dt))
             if len(groups) > 1 and groups[1][1] > 1:
-                o1, sz1 = groups[1]
-                k1 = min(o1, n - LANE)
-                b_mat = jnp.asarray(_rev_perm_mat(sz1, dt, off=o1 - k1))
-                ops.append(("winfused", k1, a_mat[None],
-                            b_mat[None], True, True))
-                i0 = 2
+                if skip_low_group:
+                    i0 = 2   # caller folds both low-group reversals
+                else:
+                    a_mat = jnp.asarray(_rev_perm_mat(groups[0][1], dt))
+                    o1, sz1 = groups[1]
+                    k1 = min(o1, n - LANE)
+                    b_mat = jnp.asarray(_rev_perm_mat(sz1, dt, off=o1 - k1))
+                    ops.append(("winfused", k1, a_mat[None],
+                                b_mat[None], True, True))
+                    i0 = 2
             else:
+                a_mat = jnp.asarray(_rev_perm_mat(groups[0][1], dt))
                 eye = jnp.asarray(_eye_cluster(), a_mat.dtype) if eye is None else eye
                 ops.append(("winfused", LANE, a_mat[None], eye[None],
                             True, False))

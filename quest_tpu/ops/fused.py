@@ -765,3 +765,249 @@ def _qft_ladder_lo_jit(amps, tab, *, num_qubits: int, target: int,
         interpret=interpret,
     )(view, tab)
     return out.reshape(in_shape)
+
+
+# ---------------------------------------------------------------------------
+# Multi-layer (radix-2^k) QFT ladder passes
+# ---------------------------------------------------------------------------
+#
+# The per-layer ladder above runs ONE butterfly layer per HBM sweep, so a
+# full n-qubit QFT costs ~n sweeps even though each sweep does almost no
+# arithmetic.  Classic high-radix FFT blocking fixes that: hold 2^k pair
+# bits co-resident in VMEM and run k butterfly+phase layers per sweep.
+# The reference has no analogue (its QFT is one kernel sweep per H plus
+# one per phase ladder, agnostic_applyQFT, QuEST_common.c:836-898); this
+# is a TPU-memory-hierarchy design.
+#
+#   - _qft_multi_hi: layers t in [t_lo, t_hi], all >= 14.  The state view
+#     (2, H, 2^k, M, 128, 128) makes bits [t_lo, t_hi] a co-resident block
+#     axis; each layer's controlled-phase factorizes into a per-layer
+#     (128, 128) VMEM table over bits [0, 14), an SMEM factor over bits
+#     [14, t_lo) (the block's mid coordinate), and a compile-time constant
+#     over the already-swept block bits below the layer.
+#   - _qft_cluster_multi: ALL seven sublane layers (t = 13..7) in one
+#     sweep; each layer reshapes the sublane axis exactly like
+#     _qft_ladder_lo_kernel and its phase table rows [:2^(t-7)] align with
+#     the in-block axes directly.
+
+QFT_RADIX_DEFAULT = 4    # VMEM per high pass: 2 sides * 2^k * 64 KB blocks
+
+
+def _qft_radix() -> int:
+    import os
+
+    try:
+        k = int(os.environ.get("QT_QFT_RADIX", str(QFT_RADIX_DEFAULT)))
+    except ValueError:
+        k = QFT_RADIX_DEFAULT
+    return max(1, min(5, k))
+
+
+def qft_multilayer_enabled(amps_dtype) -> bool:
+    """Multi-layer QFT passes: f32 on a real TPU by default; interpret-mode
+    execution (CPU tests) opts in via QT_QFT_ML_INTERPRET=1."""
+    import os
+
+    if np.dtype(amps_dtype) != np.float32:
+        return False
+    if os.environ.get("QT_QFT_MULTILAYER", "1") != "1":
+        return False
+    if not _interpret_default():
+        return True
+    return os.environ.get("QT_QFT_ML_INTERPRET") == "1"
+
+
+def _qft_multi_hi_kernel(k: int, sgn: float):
+    C = 1 << k
+    inv = 0.7071067811865476
+
+    def kernel(x_ref, ctab_ref, mlo_ref, mhi_ref, o_ref):
+        j = pl.program_id(1)
+        slabs = [[x_ref[0, 0, c, 0], x_ref[1, 0, c, 0]] for c in range(C)]
+        for p in range(k - 1, -1, -1):
+            ctr = ctab_ref[p, 0]                   # (128, 128) bits [0,14)
+            cti = ctab_ref[p, 1]
+            ar = mlo_ref[p, 0, j % _TL_SPLIT]      # bits [14, t_lo) factor
+            ai = mlo_ref[p, 1, j % _TL_SPLIT]
+            br = mhi_ref[p, 0, j // _TL_SPLIT]
+            bi = mhi_ref[p, 1, j // _TL_SPLIT]
+            mr = ar * br - ai * bi
+            mi = ar * bi + ai * br
+            for c0 in range(C):
+                if (c0 >> p) & 1:
+                    continue
+                c1 = c0 | (1 << p)
+                # block bits below the layer: compile-time phase constant
+                clo = c0 & ((1 << p) - 1)
+                a = sgn * np.pi * clo / float(1 << p)
+                sr = mr * float(np.cos(a)) - mi * float(np.sin(a))
+                si = mr * float(np.sin(a)) + mi * float(np.cos(a))
+                phr = sr * ctr - si * cti
+                phi_ = sr * cti + si * ctr
+                x0r, x0i = slabs[c0]
+                x1r, x1i = slabs[c1]
+                s0r = (x0r + x1r) * inv
+                s0i = (x0i + x1i) * inv
+                dr = (x0r - x1r) * inv
+                di = (x0i - x1i) * inv
+                slabs[c0] = [s0r, s0i]
+                slabs[c1] = [dr * phr - di * phi_, dr * phi_ + di * phr]
+        for c in range(C):
+            o_ref[0, 0, c, 0] = slabs[c][0]
+            o_ref[1, 0, c, 0] = slabs[c][1]
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "t_hi", "t_lo", "conj", "interpret"),
+         donate_argnums=0)
+def _qft_multi_hi_jit(amps, ctab, mlo, mhi, *, num_qubits: int, t_hi: int,
+                      t_lo: int, conj: bool, interpret: bool | None = None):
+    n, k = num_qubits, t_hi - t_lo + 1
+    in_shape = amps.shape
+    C = 1 << k
+    H = 1 << (n - 1 - t_hi)
+    M = 1 << (t_lo - CLUSTER_QUBITS)
+    if interpret is None:
+        interpret = _interpret_default()
+    view = amps.reshape(2, H, C, M, CLUSTER_DIM, CLUSTER_DIM)
+    sgn = -1.0 if conj else 1.0
+    out = pl.pallas_call(
+        _qft_multi_hi_kernel(k, sgn),
+        grid=(H, M),
+        in_specs=[
+            pl.BlockSpec((2, 1, C, 1, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, i, 0, j, 0, 0)),
+            pl.BlockSpec((k, 2, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((2, 1, C, 1, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i, j: (0, i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, ctab, mlo, mhi)
+    return out.reshape(in_shape)
+
+
+def apply_qft_multi_hi(amps, *, num_qubits: int, t_hi: int, t_lo: int,
+                       conj: bool = False, interpret: bool | None = None):
+    """Layers t = t_hi..t_lo (descending, all >= 14) in ONE pass.
+
+    SMEM budget: the stacked mid-factor tables are (k, 2, <=2048) f32 =
+    k x 16 KB (64 KB at the default radix 4) — above the single-table
+    16 KB bound the per-layer kernel keeps, but within Mosaic's scalar
+    memory: validated on the real chip at the largest enabled size
+    (full 30q f32 QFT, first chunk t_lo=26 -> M=4096, amp0 matches
+    2^-15)."""
+    import numpy as _np
+
+    n = num_qubits
+    k = t_hi - t_lo + 1
+    if not (CLUSTER_QUBITS <= t_lo <= t_hi < n and 1 <= k <= 5):
+        raise ValueError("apply_qft_multi_hi: bad layer chunk")
+    dt = _np.dtype(amps.dtype)
+    sgn = -1.0 if conj else 1.0
+    j14 = _np.arange(1 << CLUSTER_QUBITS, dtype=_np.float64)
+    ctab = _np.empty((k, 2, CLUSTER_DIM, CLUSTER_DIM), dtype=dt)
+    M = 1 << (t_lo - CLUSTER_QUBITS)
+    nlo = min(M, _TL_SPLIT)
+    nhi = max(1, M // _TL_SPLIT)
+    mlo = _np.empty((k, 2, nlo), dtype=dt)
+    mhi = _np.empty((k, 2, nhi), dtype=dt)
+    jlo = _np.arange(nlo, dtype=_np.float64)
+    jhi = _np.arange(nhi, dtype=_np.float64)
+    for p in range(k):
+        t = t_lo + p
+        a14 = sgn * _np.pi * j14 / (1 << t)
+        ctab[p, 0] = _np.cos(a14).reshape(CLUSTER_DIM, CLUSTER_DIM)
+        ctab[p, 1] = _np.sin(a14).reshape(CLUSTER_DIM, CLUSTER_DIM)
+        alo = sgn * _np.pi * jlo * (1 << CLUSTER_QUBITS) / (1 << t)
+        mlo[p, 0], mlo[p, 1] = _np.cos(alo), _np.sin(alo)
+        ahi = (sgn * _np.pi * jhi * float(_TL_SPLIT)
+               * (1 << CLUSTER_QUBITS) / (1 << t))
+        mhi[p, 0], mhi[p, 1] = _np.cos(ahi), _np.sin(ahi)
+    return _qft_multi_hi_jit(
+        amps, jnp.asarray(ctab), jnp.asarray(mlo), jnp.asarray(mhi),
+        num_qubits=n, t_hi=t_hi, t_lo=t_lo, conj=conj, interpret=interpret)
+
+
+def _qft_cluster_multi_kernel():
+    inv = 0.7071067811865476
+
+    def kernel(x_ref, tab_ref, o_ref):
+        x = x_ref[...]                      # (2, R, 128, 128)
+        R = x.shape[1]
+        for t in range(13, LANE_QUBITS - 1, -1):
+            idx = 13 - t
+            s_hi = 1 << (13 - t)
+            s_lo = 1 << (t - LANE_QUBITS)
+            v = x.reshape(2, R, s_hi, 2, s_lo, CLUSTER_DIM)
+            x0 = v[:, :, :, 0]              # (2, R, s_hi, s_lo, 128)
+            x1 = v[:, :, :, 1]
+            s0 = (x0 + x1) * inv
+            d = (x0 - x1) * inv
+            tr = tab_ref[idx, 0, :s_lo]     # (s_lo, 128)
+            ti = tab_ref[idx, 1, :s_lo]
+            y1r = d[0] * tr - d[1] * ti
+            y1i = d[0] * ti + d[1] * tr
+            out_re = jnp.stack([s0[0], y1r], axis=2)
+            out_im = jnp.stack([s0[1], y1i], axis=2)
+            x = jnp.stack([out_re, out_im]).reshape(
+                2, R, CLUSTER_DIM, CLUSTER_DIM)
+        o_ref[...] = x
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "interpret"),
+         donate_argnums=0)
+def _qft_cluster_multi_jit(amps, tab, *, num_qubits: int,
+                           interpret: bool | None = None):
+    n = num_qubits
+    in_shape = amps.shape
+    HI = 1 << (n - CLUSTER_QUBITS)
+    if interpret is None:
+        interpret = _interpret_default()
+    R = min(HI, 8)
+    view = amps.reshape(2, HI, CLUSTER_DIM, CLUSTER_DIM)
+    out = pl.pallas_call(
+        _qft_cluster_multi_kernel(),
+        grid=(HI // R,),
+        in_specs=[
+            pl.BlockSpec((2, R, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((SUBLANE_QUBITS, 2, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, R, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, tab)
+    return out.reshape(in_shape)
+
+
+def apply_qft_cluster_multi(amps, *, num_qubits: int, conj: bool = False,
+                            interpret: bool | None = None):
+    """ALL seven sublane ladder layers (t = 13..7) in ONE pass."""
+    import numpy as _np
+
+    if num_qubits < CLUSTER_QUBITS + 1:
+        raise ValueError("apply_qft_cluster_multi needs n >= 15")
+    dt = _np.dtype(amps.dtype)
+    sgn = -1.0 if conj else 1.0
+    sl = _np.arange(CLUSTER_DIM, dtype=_np.float64)[:, None]
+    ll = _np.arange(CLUSTER_DIM, dtype=_np.float64)[None, :]
+    tab = _np.empty((SUBLANE_QUBITS, 2, CLUSTER_DIM, CLUSTER_DIM), dtype=dt)
+    for t in range(13, LANE_QUBITS - 1, -1):
+        idx = 13 - t
+        ang = sgn * _np.pi * (sl * CLUSTER_DIM + ll) / (1 << t)
+        tab[idx, 0] = _np.cos(ang)
+        tab[idx, 1] = _np.sin(ang)
+    return _qft_cluster_multi_jit(amps, jnp.asarray(tab),
+                                  num_qubits=num_qubits, interpret=interpret)

@@ -1,0 +1,114 @@
+"""On-chip breakdown of the 26q fused QFT: where do the ~146 ms go?
+
+Times each stage as a composable state->state program, K-differenced
+(T[run twice] - T[run once] inside the same measurement discipline) so
+the fixed relay fetch/dispatch overhead cancels.  Stages:
+
+  - ladders: the 19 Pallas ladder layers (t = 25..7) chained
+  - lowpass: the <=7-qubit dense window pass
+  - reversal: bit_reversal_ops (3 window passes + 1 axis permute)
+  - permute-only: just the group-order axis permutation
+  - full: circuit.fused_qft monolithic under one jit (canonical in/out)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as CIRC
+from quest_tpu.models import circuits
+from quest_tpu.ops import kernels
+
+N = int(os.environ.get("QT_N", "26"))
+REPS = int(os.environ.get("QT_REPS", "5"))
+
+
+def canon(n):
+    return circuits.zero_state_canonical(n)
+
+
+def kdiff(label, fn1, fn2):
+    """min T[fn2] - min T[fn1] with a device fetch each, over REPS."""
+    best1 = best2 = 1e9
+    out = fn1(canon(N))
+    float(np.asarray(jnp.sum(out[:1, :1, :1, :1])))  # warm compile 1
+    out = fn2(canon(N))
+    float(np.asarray(jnp.sum(out[:1, :1, :1, :1])))  # warm compile 2
+    for _ in range(REPS):
+        s = canon(N)
+        t0 = time.perf_counter()
+        out = fn1(s)
+        float(np.asarray(jnp.sum(out[:1, :1, :1, :1])))
+        best1 = min(best1, time.perf_counter() - t0)
+        s = canon(N)
+        t0 = time.perf_counter()
+        out = fn2(s)
+        float(np.asarray(jnp.sum(out[:1, :1, :1, :1])))
+        best2 = min(best2, time.perf_counter() - t0)
+    print(f"{label}: {(best2 - best1) * 1e3:8.2f} ms"
+          f"   (1x {best1 * 1e3:7.2f}  2x {best2 * 1e3:7.2f})", flush=True)
+    return best2 - best1
+
+
+def ladders(a):
+    for t in range(N - 1, 6, -1):
+        a = kernels.apply_qft_ladder(a, num_qubits=N, target=t)
+    return a
+
+
+def lowpass(a):
+    dt = np.float32
+    dense = [CIRC.Gate(tuple(range(0, qq + 1)), CIRC._qft_layer_dense(qq, False, dt))
+             for qq in range(6, -1, -1)]
+    return CIRC.execute_plan(a, CIRC.plan_circuit(dense, N), N)
+
+
+def reversal(a):
+    ops = CIRC.bit_reversal_ops(N, [(0, N)], np.float32)
+    return CIRC.execute_plan(a, ops, N)
+
+
+def permute_only(a):
+    ops = [op for op in CIRC.bit_reversal_ops(N, [(0, N)], np.float32)
+           if op[0] == "permute"]
+    return CIRC.execute_plan(a, ops, N)
+
+
+def full(a):
+    return CIRC.fused_qft(a, N, 0, N)
+
+
+def ladder_one(a, t=20):
+    return kernels.apply_qft_ladder(a, num_qubits=N, target=t)
+
+
+def main():
+    mult = int(os.environ.get("QT_MULT", "4"))
+
+    def rep(stage, k):
+        def f(a):
+            for _ in range(k):
+                a = stage(a)
+            return a
+        return f
+
+    stages = [("ladders(19)", ladders), ("reversal", reversal),
+              ("permute-only", permute_only), ("lad-t25", lambda a: ladder_one(a, 25)),
+              ("lad-t20", lambda a: ladder_one(a, 20)),
+              ("lad-t14", lambda a: ladder_one(a, 14)),
+              ("lad-t10", lambda a: ladder_one(a, 10)),
+              ("lad-t7", lambda a: ladder_one(a, 7)),
+              ("full-mono", full)]
+    for label, stage in stages:
+        j1 = jax.jit(rep(stage, 1), donate_argnums=0)
+        j2 = jax.jit(rep(stage, 1 + mult), donate_argnums=0)
+        d = kdiff(label, j1, j2)
+        print(f"   -> per-unit {d / mult * 1e3:7.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""On-chip validation + timing of the multilayer QFT at 26q.
+
+Correctness: multilayer vs per-layer fused path on the same random state
+(both f32, same input), plus amp0 = 2^-n/2 self-check on |0>.
+Timing: K-diff with QT_MULT extra reps (default 4).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as CIRC
+from quest_tpu.models import circuits
+
+N = int(os.environ.get("QT_N", "26"))
+REPS = int(os.environ.get("QT_REPS", "5"))
+MULT = int(os.environ.get("QT_MULT", "4"))
+
+
+def main():
+    os.environ.setdefault("QT_QFT_MULTILAYER", "1")
+
+    def ml(a):
+        return CIRC._fused_qft_multilayer(a, N, N, None)
+
+    # correctness: multilayer vs per-layer on a small-but-canonical state
+    nchk = min(N, 17)
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(1 << nchk) + 1j * rng.standard_normal(1 << nchk)
+    v /= np.linalg.norm(v)
+    soa = np.stack([v.real, v.imag]).astype(np.float32)
+    out = np.asarray(CIRC._fused_qft_multilayer(
+        jnp.asarray(soa), nchk, nchk, None))
+    got = out[0] + 1j * out[1]
+    want = np.fft.ifft(v, norm="ortho")
+    print(f"{nchk}q on-chip multilayer vs ifft: "
+          f"{np.abs(got - want).max():.3e}", flush=True)
+
+    # amp0 self-check at N on |0>: QFT|0> has all amps = 2^-N/2
+    z = circuits.zero_state_canonical(N)
+    t0 = time.perf_counter()
+    outz = jax.jit(ml, donate_argnums=0)(z)
+    a0 = float(np.asarray(outz.reshape(2, -1)[0, 0]))
+    print(f"{N}q compile+first: {time.perf_counter() - t0:.1f} s; "
+          f"amp0 {a0:.6e} vs {2 ** (-N / 2):.6e}", flush=True)
+
+    # K-diff timing
+    j1 = jax.jit(ml, donate_argnums=0)
+
+    def mlk(a):
+        for _ in range(1 + MULT):
+            a = ml(a)
+        return a
+
+    j2 = jax.jit(mlk, donate_argnums=0)
+    best1 = best2 = 1e9
+    out = j2(circuits.zero_state_canonical(N))
+    float(np.asarray(out.reshape(2, -1)[0, 0]))
+    for _ in range(REPS):
+        s = circuits.zero_state_canonical(N)
+        t0 = time.perf_counter()
+        out = j1(s)
+        float(np.asarray(out.reshape(2, -1)[0, 0]))
+        best1 = min(best1, time.perf_counter() - t0)
+        s = circuits.zero_state_canonical(N)
+        t0 = time.perf_counter()
+        out = j2(s)
+        float(np.asarray(out.reshape(2, -1)[0, 0]))
+        best2 = min(best2, time.perf_counter() - t0)
+    d = (best2 - best1) / MULT
+    print(f"{N}q multilayer QFT device (K-diff/{MULT}): {d * 1e3:.2f} ms"
+          f"   (1x {best1 * 1e3:.2f}  {1 + MULT}x {best2 * 1e3:.2f})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Same-process A/B of QFT radix settings (cancels session drift)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+import numpy as np
+
+from quest_tpu import circuit as CIRC
+from quest_tpu.models import circuits
+
+N = int(os.environ.get("QT_N", "26"))
+REPS = int(os.environ.get("QT_REPS", "6"))
+MULT = int(os.environ.get("QT_MULT", "4"))
+
+
+def make(radix):
+    os.environ["QT_QFT_RADIX"] = str(radix)
+
+    def one(a):
+        return CIRC._fused_qft_multilayer(a, N, N, None)
+
+    def many(a):
+        for _ in range(1 + MULT):
+            a = CIRC._fused_qft_multilayer(a, N, N, None)
+        return a
+
+    return (jax.jit(one, donate_argnums=0), jax.jit(many, donate_argnums=0))
+
+
+def fetch(out):
+    return float(np.asarray(out.reshape(2, -1)[0, 0]))
+
+
+def main():
+    radices = [int(r) for r in os.environ.get("QT_RADICES", "3,4").split(",")]
+    jits = {r: make(r) for r in radices}
+    for r, (j1, j2) in jits.items():   # warm compiles
+        fetch(j1(circuits.zero_state_canonical(N)))
+        fetch(j2(circuits.zero_state_canonical(N)))
+    best = {r: [1e9, 1e9] for r in radices}
+    for _ in range(REPS):
+        for r, (j1, j2) in jits.items():
+            t0 = time.perf_counter()
+            fetch(j1(circuits.zero_state_canonical(N)))
+            best[r][0] = min(best[r][0], time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fetch(j2(circuits.zero_state_canonical(N)))
+            best[r][1] = min(best[r][1], time.perf_counter() - t0)
+    for r in radices:
+        b1, b2 = best[r]
+        print(f"radix {r}: {(b2 - b1) / MULT * 1e3:7.2f} ms"
+              f"  (1x {b1 * 1e3:7.2f}  {1 + MULT}x {b2 * 1e3:7.2f})",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
