@@ -1382,15 +1382,8 @@ def _fused_qft_multilayer(amps, n: int, count: int,
     reference's per-gate dispatch is ~2.5n sweeps (agnostic_applyQFT,
     QuEST_common.c:836-898)."""
     dt = np.float64 if amps.dtype == jnp.float64 else np.float32
-    K = fused._qft_radix()
-    t = count - 1
-    while t >= WINDOW:
-        t_lo = max(WINDOW, t - K + 1)
-        amps = fused.apply_qft_multi_hi(
-            amps, num_qubits=n, t_hi=t, t_lo=t_lo, interpret=interpret)
-        t = t_lo - 1
-    amps = fused.apply_qft_cluster_multi(
-        amps, num_qubits=n, interpret=interpret)
+    amps = fused.apply_qft_multilayer_ladders(
+        amps, num_qubits=n, t_top=count - 1, interpret=interpret)
     dense_gates = [Gate(tuple(range(qq + 1)), _qft_layer_dense(qq, False, dt))
                    for qq in range(LANE - 1, -1, -1)]
     rev7 = _rev_perm_mat(LANE, dt)

@@ -1011,3 +1011,25 @@ def apply_qft_cluster_multi(amps, *, num_qubits: int, conj: bool = False,
         tab[idx, 1] = _np.sin(ang)
     return _qft_cluster_multi_jit(amps, jnp.asarray(tab),
                                   num_qubits=num_qubits, interpret=interpret)
+
+
+def apply_qft_multilayer_ladders(amps, *, num_qubits: int, t_top: int,
+                                 conj: bool = False,
+                                 interpret: bool | None = None,
+                                 radix: int | None = None):
+    """Ladder layers t = t_top .. 7 (descending) via the multilayer
+    kernels: radix-2^k chunks for t >= 14, then ONE cluster pass for the
+    seven sublane layers.  Shared by the unsharded QFT
+    (circuit._fused_qft_multilayer) and the per-shard local layers of the
+    sharded QFT (parallel.dist.fused_qft_sharded) so both use identical
+    layer grouping.  Requires t_top >= 13 and num_qubits >= 15."""
+    if radix is None:
+        radix = _qft_radix()
+    t = t_top
+    while t >= CLUSTER_QUBITS:
+        t_lo = max(CLUSTER_QUBITS, t - radix + 1)
+        amps = apply_qft_multi_hi(amps, num_qubits=num_qubits, t_hi=t,
+                                  t_lo=t_lo, conj=conj, interpret=interpret)
+        t = t_lo - 1
+    return apply_qft_cluster_multi(amps, num_qubits=num_qubits, conj=conj,
+                                   interpret=interpret)

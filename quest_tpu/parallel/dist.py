@@ -347,6 +347,8 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
 
     Collectives: r ppermutes + 1 all_to_all, all riding ICI.
     """
+    from ..ops import fused as _fused
+
     n = num_qubits
     ndev = amp_axis_size(mesh)
     r = num_shard_bits(mesh)
@@ -354,6 +356,9 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
     dt = amps.dtype
     sgn = -1.0 if conj else 1.0
     inv = 0.7071067811865476
+    use_multilayer = (_fused.qft_multilayer_enabled(dt)
+                      and nloc >= _fused.CLUSTER_QUBITS + 1)
+    radix = _fused._qft_radix()
 
     # host-precomputed local phase tables per mesh layer
     layer_chunks = {
@@ -379,11 +384,22 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
             ph = _apply_local_phase(comb, layer_chunks[t])
             ph = cplx.cmul(ph, jnp.cos(theta), jnp.sin(theta))
             local = jnp.where(mybit == 1, ph, comb)
-        # local layers, per shard: Pallas ladders for t >= 7, the XLA
-        # elementwise ladder below (a dense window-pass fold here can
-        # overflow scoped VMEM when XLA promotes a small shard into VMEM
-        # inside this one big program)
-        for t in range(nloc - 1, -1, -1):
+        # local layers, per shard: multilayer (radix-2^k) passes when the
+        # shard is big enough — the SAME grouping helper the unsharded
+        # path uses (fused.apply_qft_multilayer_ladders) — else per-layer
+        # Pallas ladders for t >= 7 and the XLA elementwise ladder below
+        # (a dense window-pass fold here can overflow scoped VMEM when
+        # XLA promotes a small shard into VMEM inside this one big
+        # program).  NB use_multilayer/radix resolve at TRACE time (the
+        # env toggles are frozen into any enclosing jit's cache).
+        if use_multilayer:
+            local = _fused.apply_qft_multilayer_ladders(
+                local, num_qubits=nloc, conj=conj, t_top=nloc - 1,
+                radix=radix)
+            low_start = _fused.LANE_QUBITS - 1
+        else:
+            low_start = nloc - 1
+        for t in range(low_start, -1, -1):
             local = kernels.apply_qft_ladder(
                 local, num_qubits=nloc, target=t, conj=conj)
         # bit reversal: L1 local, all_to_all block swap, L2 local

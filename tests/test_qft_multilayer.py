@@ -90,3 +90,27 @@ def test_fused_qft_routes_to_multilayer(monkeypatch):
     monkeypatch.setenv("QT_QFT_MULTILAYER", "0")
     out_pl = np.asarray(CIRC.fused_qft(_soa(v), n, 0, n))
     assert np.abs(out_ml - out_pl).max() < 2e-6
+
+
+def test_sharded_qft_multilayer_local_layers(monkeypatch):
+    """fused_qft_sharded with a shard big enough for multilayer local
+    passes (nloc >= 15) matches the DFT oracle — the radix-2^k kernels
+    running per shard inside the shard_map."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from quest_tpu.parallel import dist
+    from quest_tpu.env import AMP_AXIS
+
+    monkeypatch.setenv("QT_QFT_ML_INTERPRET", "1")
+    n = 18                              # 8 shards -> nloc = 15
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), (AMP_AXIS,))
+    v = _rand(n, 99)
+    soa = jax.device_put(
+        _soa(v), NamedSharding(mesh, P(None, AMP_AXIS)))
+    out = np.asarray(dist.fused_qft_sharded(
+        soa.reshape(2, -1), mesh=mesh, num_qubits=n))
+    got = out[0] + 1j * out[1]
+    want = np.fft.ifft(v, norm="ortho")
+    assert np.abs(got - want).max() < 2e-6
