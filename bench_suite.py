@@ -156,13 +156,15 @@ def config4():
     def run(k=1):
         rho = qt.createDensityQureg(n, env)
         qt.initPlusState(rho)
-        # depol/damping run the dedicated elementwise pair kernels (ONE
-        # HBM pass each, ops/density.py) — measured faster than folding
-        # their rank-4 superoperators into a fused drain
-        for _ in range(k):
-            for q in range(n):
-                qt.mixDepolarising(rho, q, 0.05)
-            qt.mixTwoQubitKrausMap(rho, 0, 1, ops)
+        # the whole noise block drains as ONE jitted program: depol
+        # channels capture as ChannelItems (the one-pass elementwise pair
+        # kernels, in call order) and the 2q Kraus map as a superoperator
+        # fold (fusion.capture_pair_channel / capture_raw)
+        with qt.gateFusion(rho):
+            for _ in range(k):
+                for q in range(n):
+                    qt.mixDepolarising(rho, q, 0.05)
+                qt.mixTwoQubitKrausMap(rho, 0, 1, ops)
         psi = qt.createQureg(n, env)
         qt.initPlusState(psi)
         return qt.calcFidelity(rho, psi)

@@ -216,14 +216,14 @@ def mixDepolarising(qureg: Qureg, targetQubit: int, prob: float) -> None:
     V.validate_density_matrix(qureg, "mixDepolarising")
     V.validate_target(qureg, targetQubit, "mixDepolarising")
     V.validate_one_qubit_depol_prob(prob, "mixDepolarising")
-    # NOT captured into the drain: the depolarising superoperator has
-    # operator-Schmidt rank 4 across (t | t+n), so a captured fold costs a
-    # rank-4 pass per channel (~18 ms at 2^26) where the elementwise
-    # kernel is one cheap pass (measured: fused 0.60 s vs eager 0.41 s
-    # for config 4's noise block) — but order must be preserved, so any
-    # pending fused gates drain first
+    # Under gateFusion the channel is captured as a ChannelItem — the
+    # SAME one-pass elementwise kernel, run inside the drain program in
+    # call order (never the rank-4 superoperator fold, which measured
+    # slower) — so a whole noise layer costs one dispatch.  Outside
+    # fusion this drains (no-op) and runs eagerly.
     from . import fusion
-    fusion.drain(qureg)
+    if fusion.capture_pair_channel(qureg, "depol", targetQubit, prob):
+        return
     if _pair_channel_sharded(qureg, prob, targetQubit, "depol"):
         return
     qureg.amps = D.mix_depolarising(
@@ -237,9 +237,10 @@ def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
     V.validate_density_matrix(qureg, "mixDamping")
     V.validate_target(qureg, targetQubit, "mixDamping")
     V.validate_one_qubit_damping_prob(prob, "mixDamping")
-    # not captured — see mixDepolarising (rank-4 superoperator fold)
+    # captured as a ChannelItem under gateFusion — see mixDepolarising
     from . import fusion
-    fusion.drain(qureg)
+    if fusion.capture_pair_channel(qureg, "damping", targetQubit, prob):
+        return
     if _pair_channel_sharded(qureg, prob, targetQubit, "damping"):
         return
     qureg.amps = D.mix_damping(

@@ -48,7 +48,8 @@ class FusionBuffer:
     __slots__ = ("gates",)
 
     def __init__(self):
-        self.gates: List[C.Gate] = []
+        # C.Gate and ChannelItem entries, executed in order by the drain
+        self.gates: List[object] = []
 
 
 def start_gate_fusion(qureg) -> None:
@@ -83,78 +84,148 @@ _PLAN_CACHE_MAX = 64
 _plan_cache: dict = {}
 
 
-def _plan_key(gates, nloc: int):
-    """Content key for a fully-concrete gate list, or None when any matrix
+class ChannelItem:
+    """A captured depolarise/damping channel (one-pass elementwise pair
+    kernel, ops/density.py) buffered BETWEEN gate segments: the drain runs
+    gates-and-channels in order inside one jitted program, so a noise
+    layer (BASELINE config 4) costs a single dispatch.  ``prob`` enters
+    the compiled program as a traced scalar — re-draining with a
+    different probability does not recompile."""
+
+    __slots__ = ("kind", "target", "bra", "prob")
+
+    def __init__(self, kind: str, target: int, bra: int, prob: float):
+        self.kind = kind
+        self.target = target       # ket bit position in the state vector
+        self.bra = bra             # bra twin bit (target + numQubitsRepresented)
+        self.prob = float(prob)
+
+
+def _plan_key(items, nloc: int):
+    """Content key for a fully-concrete item list, or None when any matrix
     is traced/non-numpy.  Matrices in a drain are small (2x2..128x128), so
     hashing their bytes is negligible next to planning them (~0.2 s of
-    host work per drain for a 13-qubit noise layer)."""
+    host work per drain for a 13-qubit noise layer).  Channel items key on
+    (kind, target) only — the probability is a runtime argument."""
     parts = []
-    for g in gates:
-        m = g.mat
+    for it in items:
+        if isinstance(it, ChannelItem):
+            parts.append(("chan", it.kind, it.target, it.bra))
+            continue
+        m = it.mat
         if not isinstance(m, np.ndarray):
             return None
-        parts.append((g.targets, m.dtype.str, m.shape, m.tobytes()))
+        parts.append((it.targets, m.dtype.str, m.shape, m.tobytes()))
     return (nloc, tuple(parts))
 
 
-def _run(qureg, gates) -> None:
+def _split_items(items, nloc: int):
+    """items -> (program, arrays): ``program`` is a hashable tuple of
+    ("plan", skeleton, n_arrays) / ("chan", kind, target) parts executed
+    in order; ``arrays`` the concatenated traced pass arrays (channel
+    probabilities are appended per item at _run time, not here)."""
+    program = []
+    arrays = []
+    seg = []
+
+    def flush():
+        if seg:
+            ops = C.plan_circuit(list(seg), nloc)
+            skeleton, arrs = C.split_plan(ops)
+            program.append(("plan", skeleton, len(arrs)))
+            arrays.extend(arrs)
+            seg.clear()
+
+    for it in items:
+        if isinstance(it, ChannelItem):
+            flush()
+            program.append(("chan", it.kind, it.target, it.bra))
+        else:
+            seg.append(it)
+    flush()
+    return tuple(program), tuple(arrays)
+
+
+def _run(qureg, items) -> None:
     """Plan with the CONCRETE gate matrices (so controlled gates Schmidt-
-    decompose to their true rank), then execute the whole plan as ONE
-    jitted dispatch — the pass arrays enter as traced arguments and the
-    compiled program is cached on the plan skeleton, so repeated drains of
-    the same circuit shape (e.g. angle sweeps) never recompile and cost a
-    single host->device round-trip.  Fully-concrete gate lists also cache
-    the MATERIALIZED plan (pass matrices), so repeated identical drains
-    (e.g. a fixed noise layer per benchmark rep) skip host planning
-    entirely."""
+    decompose to their true rank), then execute the whole item sequence —
+    gate-segment plans interleaved with captured channels — as ONE jitted
+    dispatch: the pass arrays and channel probabilities enter as traced
+    arguments and the compiled program is cached on the program skeleton,
+    so repeated drains of the same shape (e.g. angle sweeps, noise-layer
+    reps) never recompile and cost a single host->device round-trip.
+    Fully-concrete item lists also cache the MATERIALIZED plan (pass
+    matrices), so repeated identical drains skip host planning entirely."""
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
-    key = _plan_key(gates, nloc)
+    key = _plan_key(items, nloc)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
-        skeleton, arrays = hit
+        program, arrays = hit
     else:
-        ops = C.plan_circuit(gates, nloc)
-        skeleton, arrays = C.split_plan(ops)
+        program, arrays = _split_items(items, nloc)
         if key is not None:
             if len(_plan_cache) >= _PLAN_CACHE_MAX:
                 _plan_cache.pop(next(iter(_plan_cache)))
-            _plan_cache[key] = (skeleton, arrays)
+            _plan_cache[key] = (program, arrays)
+    probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
-    runner = _plan_runner(nloc, skeleton,
+    runner = _plan_runner(nloc, program,
                           qureg.env.mesh if nsh else None,
                           _fused.matmul_precision_name())
     # bypass the amps property (which would re-enter drain)
-    qureg._amps = runner(qureg._amps, arrays)
+    qureg._amps = runner(qureg._amps, arrays, probs)
 
 
 @lru_cache(maxsize=256)
-def _plan_runner(nloc: int, skeleton: tuple, mesh, precision: str = None):
-    """Jitted whole-plan executor.  For a sharded register the plan (all
-    gates shard-local by capture policy) runs inside ONE shard_map over
-    the amplitude mesh — the multi-chip analogue of the drain."""
+def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
+    """Jitted whole-program executor over ("plan", skeleton, n_arrays) /
+    ("chan", kind, t, b) parts in order.  For a sharded register the
+    program (all items shard-local by capture policy) runs inside ONE
+    shard_map over the amplitude mesh — the multi-chip analogue of the
+    drain."""
+    from .ops import density as _density
+
+    def _apply(amps, arrays, probs):
+        ai = pi = 0
+        for part in program:
+            if part[0] == "plan":
+                _, skeleton, na = part
+                amps = C.execute_plan(
+                    amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
+                    nloc, precision=precision)
+                ai += na
+            else:
+                _, kind, t, b = part
+                amps = _density.apply_pair_channel(
+                    amps, kind, probs[pi], nn=nloc, t=t, b=b)
+                pi += 1
+            # without this barrier XLA:TPU's memory assignment keeps every
+            # part's temporaries live to the end of the program (measured:
+            # +1.25 GiB PER CHANNEL at 13q rho -> 21 GiB OOM; flat 1.75 GiB
+            # with it)
+            amps = jax.lax.optimization_barrier(amps)
+        return amps
 
     @partial(jax.jit, donate_argnums=0)
-    def run(amps, arrays):
+    def run(amps, arrays, probs):
         if mesh is None:
-            return C.execute_plan(amps, C.rebuild_plan(skeleton, arrays),
-                                  nloc, precision=precision)
+            return _apply(amps, arrays, probs)
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from .env import AMP_AXIS
 
         def kernel(local, *arrs):
-            return C.execute_plan(local, C.rebuild_plan(skeleton, arrs),
-                                  nloc, precision=precision)
+            return _apply(local, arrs[:len(arrays)], arrs[len(arrays):])
 
         return shard_map(
             kernel, mesh=mesh,
-            in_specs=(P(None, AMP_AXIS),) + (P(),) * len(arrays),
+            in_specs=(P(None, AMP_AXIS),) + (P(),) * (len(arrays) + len(probs)),
             out_specs=P(None, AMP_AXIS),
             check_vma=False,  # pallas_call inside shard_map has no vma info
-        )(amps, *arrays)
+        )(amps, *arrays, *probs)
 
     return run
 
@@ -237,6 +308,23 @@ def capture_raw(qureg, stacked, targets) -> bool:
 
 
 _X = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
+
+
+def capture_pair_channel(qureg, kind: str, target: int, prob) -> bool:
+    """Buffer a depolarise/damping channel as a ChannelItem — the one-pass
+    elementwise pair kernel runs INSIDE the drain program, interleaved in
+    call order with the gate segments, so a whole noise layer is one
+    dispatch.  Deliberately NOT a superoperator fold (capture_raw): these
+    channels' superoperators have operator-Schmidt rank 4 across
+    (t, t+n), and a rank-4 window pass per channel measured slower than
+    the elementwise kernel (BASELINE.md round-3)."""
+    sh = qureg.num_qubits_represented
+    bits = (target, target + sh)
+    if not _capturable(qureg, bits):
+        drain(qureg)
+        return False
+    qureg._fusion.gates.append(ChannelItem(kind, target, target + sh, prob))
+    return True
 
 
 def capture_not(qureg, targets, controls=(), control_states=()) -> bool:

@@ -144,13 +144,8 @@ def mix_depolarising(amps, prob, *, num_qubits: int, target: int):
     (densmatr_mixDepolarisingLocal, QuEST_cpu.c:125-246), replacing the
     16x-element generic superoperator for this channel."""
     n = num_qubits
-    nn = 2 * n
-    p = jnp.asarray(prob, amps.dtype)
-    one = jnp.ones((), amps.dtype)
-    return _pair_channel(amps, nn, target, target + n,
-                         w_same0=1 - 2 * p / 3, w_same1=1 - 2 * p / 3,
-                         w_diff=1 - 4 * p / 3,
-                         w2_00=2 * p / 3 * one, w2_11=2 * p / 3 * one)
+    return apply_pair_channel(amps, "depol", prob, nn=2 * n, t=target,
+                              b=target + n)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "target"), donate_argnums=0)
@@ -164,13 +159,38 @@ def mix_damping(amps, prob, *, num_qubits: int, target: int):
         rho'[1,1] = (1-p) rho
     """
     n = num_qubits
-    nn = 2 * n
+    return apply_pair_channel(amps, "damping", prob, nn=2 * n, t=target,
+                              b=target + n)
+
+
+def apply_pair_channel(amps, kind: str, prob, *, nn: int, t: int, b: int):
+    """The depolarise/damping one-pass kernel with explicit bit positions
+    — ``nn`` is the number of qubits in the (possibly shard-local) array
+    and (t, b) the ket/bra target bits within it.  Lets the fusion drain
+    run captured channels on a shard-local view, where b = t + n_represented
+    but nn < 2 * n_represented (fusion.py); ``prob`` may be traced.
+
+    When many channels chain inside ONE program (the fused drain), the
+    caller must fence consecutive channels with
+    ``lax.optimization_barrier`` — XLA:TPU's memory assignment otherwise
+    keeps every channel's temporaries live to the end of the program
+    (measured +1.25 GiB per channel at 13q rho -> 21 GiB OOM; see
+    fusion._plan_runner).  The interleaved-axis view path is NOT a
+    big-state alternative: its size-2 minor axes tile-pad T(8,128) by up
+    to 64x (a 32 GiB reshape at 13q rho)."""
     p = jnp.asarray(prob, amps.dtype)
-    s = jnp.sqrt(1 - p)
     one = jnp.ones((), amps.dtype)
-    return _pair_channel(amps, nn, target, target + n,
-                         w_same0=one, w_same1=1 - p, w_diff=s,
-                         w2_00=p * one, w2_11=0 * one)
+    if kind == "depol":
+        return _pair_channel(amps, nn, t, b,
+                             w_same0=1 - 2 * p / 3, w_same1=1 - 2 * p / 3,
+                             w_diff=1 - 4 * p / 3,
+                             w2_00=2 * p / 3 * one, w2_11=2 * p / 3 * one)
+    if kind == "damping":
+        return _pair_channel(amps, nn, t, b,
+                             w_same0=one, w_same1=1 - p,
+                             w_diff=jnp.sqrt(1 - p),
+                             w2_00=p * one, w2_11=0 * one)
+    raise ValueError(f"unknown pair channel {kind!r}")
 
 
 def depolarising_kraus(prob, dtype=None):

@@ -1023,6 +1023,9 @@ def apply_qft_multilayer_ladders(amps, *, num_qubits: int, t_top: int,
     (circuit._fused_qft_multilayer) and the per-shard local layers of the
     sharded QFT (parallel.dist.fused_qft_sharded) so both use identical
     layer grouping.  Requires t_top >= 13 and num_qubits >= 15."""
+    if t_top < CLUSTER_QUBITS - 1:
+        raise ValueError("apply_qft_multilayer_ladders needs t_top >= 13 "
+                         "(the cluster pass applies ALL sublane layers)")
     if radix is None:
         radix = _qft_radix()
     t = t_top

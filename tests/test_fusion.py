@@ -265,3 +265,111 @@ class TestShardedFusion:
             assert len(q._fusion.gates) == 0
         assert abs(qt.calcProbOfOutcome(q, 15, 0) - 0.5) < 1e-6
         assert abs(qt.calcProbOfOutcome(q, 2, 0) - 0.5) < 1e-6
+
+
+class TestChannelCapture:
+    """Depolarise/damping captured as ChannelItems: the one-pass
+    elementwise kernels run inside the drain program, interleaved in call
+    order with gate segments (never the rank-4 superoperator fold)."""
+
+    def test_channels_interleave_with_gates(self, env):
+        n = 4
+        def prog(r):
+            qt.hadamard(r, 0)
+            qt.mixDepolarising(r, 1, 0.1)
+            qt.controlledNot(r, 0, 2)
+            qt.mixDamping(r, 0, 0.2)
+            qt.mixDepolarising(r, 3, 0.05)
+
+        fused = qt.createDensityQureg(n, env)
+        qt.initPlusState(fused)
+        with qt.gateFusion(fused):
+            prog(fused)
+            # buffered: 2 gate entries x2 twins... entries stay buffered
+            assert any(isinstance(g, fusion.ChannelItem)
+                       for g in fused._fusion.gates)
+        eager = qt.createDensityQureg(n, env)
+        qt.initPlusState(eager)
+        prog(eager)
+        np.testing.assert_allclose(np.asarray(fused.amps),
+                                   np.asarray(eager.amps), atol=1e-12)
+
+    def test_channel_oracle(self, env):
+        """Fused channel sequence against the dense Kraus oracle."""
+        import oracle
+
+        n = 3
+        p1, p2 = 0.3, 0.4
+        rng = np.random.default_rng(11)
+        mat = oracle.random_density(n, rng)
+        r = qt.createDensityQureg(n, env)
+        oracle.set_qureg_from_array(qt, r, mat)
+        with qt.gateFusion(r):
+            qt.mixDepolarising(r, 2, p1)
+            qt.mixDamping(r, 1, p2)
+        X = oracle.full_operator(n, [2], oracle.X)
+        Y = oracle.full_operator(n, [2], oracle.Y)
+        Z = oracle.full_operator(n, [2], oracle.Z)
+        ref = (1 - p1) * mat + (p1 / 3) * (
+            X @ mat @ X + Y @ mat @ Y + Z @ mat @ Z)
+        k0 = np.array([[1, 0], [0, np.sqrt(1 - p2)]])
+        k1 = np.array([[0, np.sqrt(p2)], [0, 0]])
+        ref = oracle.apply_kraus_to_density(ref, n, [1], [k0, k1])
+        got = oracle.state_from_qureg(r)
+        np.testing.assert_allclose(got, ref, atol=1e-10)
+
+    def test_reprob_no_recompile_key(self, env):
+        """Same shape, different probabilities -> same cached plan key."""
+        n = 3
+        keys = []
+        for p in (0.1, 0.25):
+            r = qt.createDensityQureg(n, env)
+            qt.initPlusState(r)
+            with qt.gateFusion(r):
+                qt.hadamard(r, 0)
+                qt.mixDepolarising(r, 1, p)
+                items = list(r._fusion.gates)
+                keys.append(fusion._plan_key(
+                    items, r.num_qubits_in_state_vec))
+        assert keys[0] == keys[1]
+
+    def test_sharded_register_channel_capture(self):
+        """On a sharded density register, shard-local channels capture and
+        the drain (one shard_map) matches the eager path."""
+        env8 = qt.createQuESTEnv()
+        if env8.num_devices < 8:
+            pytest.skip("needs 8 virtual devices")
+        n = 7                    # 2n=14 on 8 shards -> nloc=11
+        def prog(r):
+            qt.hadamard(r, 0)
+            qt.mixDepolarising(r, 1, 0.2)   # bits (1, 8): local
+            qt.mixDamping(r, 0, 0.1)        # bits (0, 7): local
+        fused = qt.createDensityQureg(n, env8)
+        qt.initPlusState(fused)
+        with qt.gateFusion(fused):
+            prog(fused)
+        eager = qt.createDensityQureg(n, env8)
+        qt.initPlusState(eager)
+        prog(eager)
+        np.testing.assert_allclose(np.asarray(fused.amps),
+                                   np.asarray(eager.amps), atol=1e-12)
+
+    def test_sharded_bra_bit_channel_falls_back(self):
+        """A channel whose bra bit is a mesh coordinate drains the buffer
+        and takes the explicit-distributed path, preserving order."""
+        env8 = qt.createQuESTEnv()
+        if env8.num_devices < 8:
+            pytest.skip("needs 8 virtual devices")
+        n = 7
+        fused = qt.createDensityQureg(n, env8)
+        qt.initPlusState(fused)
+        with qt.gateFusion(fused):
+            qt.hadamard(fused, 0)
+            qt.mixDepolarising(fused, 6, 0.2)   # bra bit 13 >= nloc=11
+            assert not fused._fusion.gates      # drained + eager
+        eager = qt.createDensityQureg(n, env8)
+        qt.initPlusState(eager)
+        qt.hadamard(eager, 0)
+        qt.mixDepolarising(eager, 6, 0.2)
+        np.testing.assert_allclose(np.asarray(fused.amps),
+                                   np.asarray(eager.amps), atol=1e-12)
