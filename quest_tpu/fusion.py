@@ -101,7 +101,7 @@ class ChannelItem:
         self.prob = float(prob)
 
 
-def _plan_key(items, nloc: int):
+def _plan_key(items, nloc: int, sweep_ok: bool):
     """Content key for a fully-concrete item list, or None when any matrix
     is traced/non-numpy.  Matrices in a drain are small (2x2..128x128), so
     hashing their bytes is negligible next to planning them (~0.2 s of
@@ -116,19 +116,24 @@ def _plan_key(items, nloc: int):
         if not isinstance(m, np.ndarray):
             return None
         parts.append((it.targets, m.dtype.str, m.shape, m.tobytes()))
-    return (nloc, tuple(parts))
+    return (nloc, sweep_ok, tuple(parts))
 
 
-def _split_items(items, nloc: int):
+def _split_items(items, nloc: int, sweep_ok: bool):
     """items -> (program, arrays): ``program`` is a hashable tuple of
-    ("plan", skeleton, n_arrays) / ("chan", kind, target) parts executed
-    in order; ``arrays`` the concatenated traced pass arrays (channel
-    probabilities are appended per item at _run time, not here)."""
+    ("plan", skeleton, n_arrays) / ("chan", kind, t, b) /
+    ("chansweep", ((kind, t, b), ...)) parts executed in order; ``arrays``
+    the concatenated traced pass arrays (channel probabilities are
+    appended per item at _run time, not here).  With ``sweep_ok``,
+    consecutive sweep-eligible channels (ket bit < 14) collapse into ONE
+    chansweep part — a few co-residency HBM sweeps for a whole noise
+    layer (fused.apply_pair_channel_sweep)."""
     program = []
     arrays = []
     seg = []
+    chans = []
 
-    def flush():
+    def flush_gates():
         if seg:
             ops = C.plan_circuit(list(seg), nloc)
             skeleton, arrs = C.split_plan(ops)
@@ -136,13 +141,26 @@ def _split_items(items, nloc: int):
             arrays.extend(arrs)
             seg.clear()
 
+    def flush_chans():
+        if not chans:
+            return
+        sweepable = (sweep_ok and nloc >= 15
+                     and all(t < 14 for _, t, _b in chans))
+        if sweepable:
+            program.append(("chansweep", tuple(chans)))
+        else:
+            program.extend(("chan", kind, t, b) for kind, t, b in chans)
+        chans.clear()
+
     for it in items:
         if isinstance(it, ChannelItem):
-            flush()
-            program.append(("chan", it.kind, it.target, it.bra))
+            flush_gates()
+            chans.append((it.kind, it.target, it.bra))
         else:
+            flush_chans()
             seg.append(it)
-    flush()
+    flush_chans()
+    flush_gates()
     return tuple(program), tuple(arrays)
 
 
@@ -159,12 +177,14 @@ def _run(qureg, items) -> None:
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
-    key = _plan_key(items, nloc)
+    from .ops import fused as _fusedmod
+    sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
+    key = _plan_key(items, nloc, sweep_ok)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
         program, arrays = hit
     else:
-        program, arrays = _split_items(items, nloc)
+        program, arrays = _split_items(items, nloc, sweep_ok)
         if key is not None:
             if len(_plan_cache) >= _PLAN_CACHE_MAX:
                 _plan_cache.pop(next(iter(_plan_cache)))
@@ -196,6 +216,14 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
                     amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
                     nloc, precision=precision)
                 ai += na
+            elif part[0] == "chansweep":
+                entries = part[1]
+                from .ops import fused as _fusedmod
+                amps = _fusedmod.apply_pair_channel_sweep(
+                    amps.reshape(2, -1), entries,
+                    probs[pi:pi + len(entries)],
+                    num_bits=nloc).reshape(amps.shape)
+                pi += len(entries)
             else:
                 _, kind, t, b = part
                 amps = _density.apply_pair_channel(

@@ -1036,3 +1036,244 @@ def apply_qft_multilayer_ladders(amps, *, num_qubits: int, t_top: int,
         t = t_lo - 1
     return apply_qft_cluster_multi(amps, num_qubits=num_qubits, conj=conj,
                                    interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused pair-channel sweep: many commuting channels per HBM pass
+# ---------------------------------------------------------------------------
+#
+# A depolarise/damping channel on a density register pairs each element
+# with its double-bit-flip partner (ket bit t, bra bit b) and combines
+# them with block weights (ops/density.py _pair_channel).  Run eagerly,
+# each channel costs several HBM passes (flip + combine).  Here the same
+# co-residency trick as the multilayer QFT applies: hold 2^k bra (grid)
+# bits co-resident in VMEM and run every channel whose bra bit falls in
+# that chunk per sweep — partner slabs are in-block, the ket-bit flip is
+# a sublane reshape (t >= 7) or an EXACT 3-term bf16 matmul against a
+# 0/1 lane permutation (t < 7; 8+8+8 mantissa bits cover f32, so the
+# split is lossless and each term is a single MXU pass — Mosaic rejects
+# lane-axis reshape flips).  The reference's channel kernels are one
+# full sweep per channel (QuEST_cpu.c:125-385).
+
+_CHAN_SWEEP_RADIX = 3   # C=8 slabs; C=16 overflows scoped VMEM (16.8M > 16M)
+
+
+def channel_sweep_enabled(amps_dtype) -> bool:
+    """Fused channel sweeps: f32 on a real TPU by default; interpret-mode
+    (CPU tests) opts in via QT_CHAN_SWEEP_INTERPRET=1."""
+    import os
+
+    if np.dtype(amps_dtype) != np.float32:
+        return False
+    if os.environ.get("QT_CHAN_SWEEP", "1") != "1":
+        return False
+    if not _interpret_default():
+        return True
+    return os.environ.get("QT_CHAN_SWEEP_INTERPRET") == "1"
+
+
+def _lane_xmat_np(t: int) -> np.ndarray:
+    """0/1 lane permutation matrix for X on lane bit t (y = x @ P)."""
+    d = CLUSTER_DIM
+    m = np.zeros((d, d), np.float32)
+    idx = np.arange(d)
+    m[idx ^ (1 << t), idx] = 1.0
+    return m
+
+
+def _exact_lane_perm(x, p_bf16):
+    """x @ P for a 0/1 permutation P, exact at f32: 3-term bf16 split of x
+    (the terms sum to x exactly; P is exact in bf16), f32 accumulation,
+    one MXU pass per term."""
+    f32 = jnp.float32
+    xh = x.astype(jnp.bfloat16)
+    r1 = x - xh.astype(f32)
+    xm = r1.astype(jnp.bfloat16)
+    xl = (r1 - xm.astype(f32)).astype(jnp.bfloat16)
+    dims = (((x.ndim - 1,), (0,)), ((), ()))
+    d = partial(jax.lax.dot_general, dimension_numbers=dims,
+                preferred_element_type=f32)
+    return d(xh, p_bf16) + d(xm, p_bf16) + d(xl, p_bf16)
+
+
+def _flip_ket_block(x, t: int, xmap, xmats_ref):
+    """In-block flip of cluster bit t over a whole (..., 128, 128) array:
+    sublane reshape for t >= 7, exact lane-permutation matmul for t < 7."""
+    lead = x.shape[:-2]
+    if t >= LANE_QUBITS:
+        s = t - LANE_QUBITS
+        s_hi, s_lo = 1 << (SUBLANE_QUBITS - 1 - s), 1 << s
+        v = x.reshape(lead + (s_hi, 2, s_lo, CLUSTER_DIM))
+        ax = len(lead) + 1
+        f = jnp.concatenate(
+            [jax.lax.slice_in_dim(v, 1, 2, axis=ax),
+             jax.lax.slice_in_dim(v, 0, 1, axis=ax)], axis=ax)
+        return f.reshape(lead + (CLUSTER_DIM, CLUSTER_DIM))
+    return _exact_lane_perm(x, xmats_ref[xmap[t]])
+
+
+def _bit_mask_2d(t: int, dt):
+    """(128, 128) {0,1} mask of cluster bit t, iota-built in-kernel."""
+    if t < LANE_QUBITS:
+        i = jax.lax.broadcasted_iota(jnp.int32, (CLUSTER_DIM, CLUSTER_DIM), 1)
+        return ((i >> t) & 1).astype(dt)
+    i = jax.lax.broadcasted_iota(jnp.int32, (CLUSTER_DIM, CLUSTER_DIM), 0)
+    return ((i >> (t - LANE_QUBITS)) & 1).astype(dt)
+
+
+def _chan_sweep_kernel(chunk, k: int, xmap):
+    """One sweep applying ``chunk`` channels in order, whole-block style
+    (per-slab fragmentation measured 1000x slower under Mosaic).  chunk
+    entries: (t, b, pbit, wi) — for a grid-bra channel, pbit = the bra
+    bit's position within the 2^k block axis; for an in-block channel
+    (bra < 14) pbit is None and the partner is the double flip (t, b) on
+    the same element block.  Weights (nchan, 5) = (w_same0, w_same1,
+    w_diff, w2_00, w2_11) live in SMEM; ket/bra cluster-bit masks are
+    iota-built; lane X permutations come in as a stacked bf16 VMEM arg."""
+    C = 1 << k
+
+    def kernel(x_ref, w_ref, xmats_ref, o_ref):
+        dt = x_ref.dtype
+        x = x_ref[...].reshape(2, C, CLUSTER_DIM, CLUSTER_DIM)
+        for t, b, pbit, wi in chunk:
+            kt = _bit_mask_2d(t, dt)
+            ws0 = w_ref[wi, 0]
+            ws1 = w_ref[wi, 1]
+            wd = w_ref[wi, 2]
+            w2_00 = w_ref[wi, 3]
+            w2_11 = w_ref[wi, 4]
+            if pbit is None:
+                bt = _bit_mask_2d(b, dt)
+                k1b1 = kt * bt
+                k0b0 = (1 - kt) * (1 - bt)
+                w1 = wd + (ws0 - wd) * k0b0 + (ws1 - wd) * k1b1
+                w2 = w2_00 * k0b0 + w2_11 * k1b1
+                f = _flip_ket_block(
+                    _flip_ket_block(x, t, xmap, xmats_ref),
+                    b, xmap, xmats_ref)
+                x = x * w1 + f * w2
+                continue
+            chi, clo = 1 << (k - 1 - pbit), 1 << pbit
+            v = x.reshape(2, chi, 2, clo, CLUSTER_DIM, CLUSTER_DIM)
+            x0 = v[:, :, 0]                  # (2, chi, clo, 128, 128)
+            x1 = v[:, :, 1]
+            f1 = _flip_ket_block(x1, t, xmap, xmats_ref)
+            f0 = _flip_ket_block(x0, t, xmap, xmats_ref)
+            w1_0 = ws0 * (1 - kt) + wd * kt      # bra bit 0
+            w1_1 = wd * (1 - kt) + ws1 * kt      # bra bit 1
+            y0 = x0 * w1_0 + f1 * (w2_00 * (1 - kt))
+            y1 = x1 * w1_1 + f0 * (w2_11 * kt)
+            x = jnp.stack([y0, y1], axis=2).reshape(
+                2, C, CLUSTER_DIM, CLUSTER_DIM)
+        o_ref[...] = x.reshape(o_ref.shape)
+
+    return kernel
+
+
+def _chan_sweep_pass(amps, wmat, xmats, *, num_bits: int, b0: int, k: int,
+                     chunk: tuple, xmap_items: tuple,
+                     interpret: bool | None = None):
+    """One pallas sweep over the (2, H, 2^k, M, 128, 128) view with grid
+    bits [b0, b0+k) co-resident.  Plain traced function: callers (the
+    fusion drain, tests) jit around it."""
+    nn = num_bits
+    in_shape = amps.shape
+    C = 1 << k
+    H = 1 << (nn - b0 - k)
+    M = 1 << (b0 - CLUSTER_QUBITS)
+    if interpret is None:
+        interpret = _interpret_default()
+    xmap = dict(xmap_items)
+    view = amps.reshape(2, H, C, M, CLUSTER_DIM, CLUSTER_DIM)
+    nx = max(1, xmats.shape[0])
+    out = pl.pallas_call(
+        _chan_sweep_kernel(chunk, k, xmap),
+        grid=(H, M),
+        in_specs=[
+            pl.BlockSpec((2, 1, C, 1, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, i, 0, j, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((nx, CLUSTER_DIM, CLUSTER_DIM),
+                         lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, 1, C, 1, CLUSTER_DIM, CLUSTER_DIM),
+                               lambda i, j: (0, i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, wmat, xmats)
+    return out.reshape(in_shape)
+
+
+def channel_weights(kind: str, prob, dtype):
+    """(5,) traced weight vector (w_same0, w_same1, w_diff, w2_00, w2_11)
+    for one pair channel — the same parametrization ops/density.py's
+    eager kernels use."""
+    p = jnp.asarray(prob, dtype)
+    one = jnp.ones((), dtype)
+    if kind == "depol":
+        return jnp.stack([1 - 2 * p / 3, 1 - 2 * p / 3, 1 - 4 * p / 3,
+                          2 * p / 3 * one, 2 * p / 3 * one])
+    if kind == "damping":
+        return jnp.stack([one, 1 - p, jnp.sqrt(1 - p),
+                          p * one, 0 * one])
+    raise ValueError(f"unknown pair channel {kind!r}")
+
+
+def apply_pair_channel_sweep(amps, program: tuple, probs, *, num_bits: int,
+                             interpret: bool | None = None):
+    """Run an ordered sequence of pair channels in FEW HBM sweeps.
+
+    ``program``: static tuple of (kind, t, b) with every t, and any
+    in-block b, below 14 and num_bits >= 15.  Grid-bra channels are
+    grouped into chunks of _CHAN_SWEEP_RADIX co-resident bra bits (one
+    sweep each, channels kept in call order within a chunk; channels in
+    different chunks act on disjoint (t, b) pairs and commute); in-block
+    channels ride the first sweep.  ``probs`` are traced — same program
+    with new probabilities reuses the compiled sweeps."""
+    nn = num_bits
+    if nn < CLUSTER_QUBITS + 1:
+        raise ValueError("apply_pair_channel_sweep needs num_bits >= 15")
+    for kind, t, b in program:
+        if t >= CLUSTER_QUBITS or b >= nn:
+            raise ValueError("sweep channels need ket bit < 14")
+    dt = amps.dtype
+    wmat = jnp.stack([channel_weights(kind, p, dt)
+                      for (kind, _, _), p in zip(program, probs)])
+    lane_ts = sorted({t for _, t, b in program if t < LANE_QUBITS}
+                     | {b for _, t, b in program
+                        if b < LANE_QUBITS})
+    xmap_items = tuple((t, i) for i, t in enumerate(lane_ts))
+    if lane_ts:
+        xmats = jnp.asarray(np.stack([_lane_xmat_np(t) for t in lane_ts]),
+                            jnp.bfloat16)
+    else:
+        xmats = jnp.zeros((1, CLUSTER_DIM, CLUSTER_DIM), jnp.bfloat16)
+    K = _CHAN_SWEEP_RADIX
+    # chunk grid-bra channels by bra-bit range, preserving call order
+    chunks = []          # (b0, [entries])
+    inblock = []
+    for wi, (kind, t, b) in enumerate(program):
+        if b < CLUSTER_QUBITS:
+            inblock.append((t, b, None, wi))
+            continue
+        placed = False
+        for ch in chunks:
+            if ch[0] <= b < ch[0] + min(K, nn - ch[0]):
+                ch[1].append((t, b, b - ch[0], wi))
+                placed = True
+                break
+        if not placed:
+            b0 = max(CLUSTER_QUBITS, min(b, nn - K))
+            chunks.append((b0, [(t, b, b - b0, wi)]))
+    if not chunks:
+        chunks.append((CLUSTER_QUBITS, []))
+    if inblock:
+        chunks[0][1][:0] = inblock
+    for b0, entries in chunks:
+        k = min(K, nn - b0)
+        amps = _chan_sweep_pass(
+            amps, wmat, xmats, num_bits=nn, b0=b0, k=k,
+            chunk=tuple(entries), xmap_items=xmap_items,
+            interpret=interpret)
+    return amps

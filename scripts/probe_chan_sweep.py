@@ -1,0 +1,30 @@
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import numpy as np, jax, jax.numpy as jnp
+from quest_tpu.ops import fused as F
+
+n, nn = 13, 26
+prog = tuple(("depol", t, t + n) for t in range(n))
+probs = tuple(0.05 for _ in range(n))
+_init = jax.jit(lambda: jnp.full((2, 1 << nn), 0.001, jnp.float32))
+def fresh():
+    return _init()
+
+MULT = 4
+def sweep1(a):
+    return F.apply_pair_channel_sweep(a, prog, probs, num_bits=nn)
+def sweepN(a):
+    for _ in range(1 + MULT):
+        a = jax.lax.optimization_barrier(F.apply_pair_channel_sweep(a, prog, probs, num_bits=nn))
+    return a
+
+j1 = jax.jit(sweep1, donate_argnums=0)
+jN = jax.jit(sweepN, donate_argnums=0)
+t0=time.time(); float(np.asarray(j1(fresh())[0,0])); print(f"compile1 {time.time()-t0:.0f}s", flush=True)
+t0=time.time(); float(np.asarray(jN(fresh())[0,0])); print(f"compileN {time.time()-t0:.0f}s", flush=True)
+b1 = bN = 9e9
+for _ in range(5):
+    t0 = time.perf_counter(); float(np.asarray(j1(fresh())[0,0])); b1 = min(b1, time.perf_counter()-t0)
+    t0 = time.perf_counter(); float(np.asarray(jN(fresh())[0,0])); bN = min(bN, time.perf_counter()-t0)
+print(f"sweep 13ch block: {(bN-b1)/MULT*1e3:.2f} ms K-diff (1x {b1*1e3:.0f} ms)", flush=True)

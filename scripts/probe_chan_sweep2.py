@@ -1,0 +1,25 @@
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import numpy as np, jax, jax.numpy as jnp
+from quest_tpu.ops import fused as F
+
+nn = 26
+rng = np.random.default_rng(1)
+def fresh():
+    return jnp.asarray(rng.standard_normal((2, 1 << nn)).astype(np.float32))
+
+cases = {
+    "sublane-only(6ch)": tuple(("depol", t, t + 13) for t in range(7, 13)),
+    "lane-only(3ch)": tuple(("depol", t, t + 13) for t in range(1, 4)),
+    "inblock-only(1ch)": (("depol", 0, 13),),
+}
+for name, prog in cases.items():
+    probs = tuple(0.05 for _ in prog)
+    j = jax.jit(lambda a, _p=prog, _pr=probs: F.apply_pair_channel_sweep(a, _p, _pr, num_bits=nn), donate_argnums=0)
+    t0 = time.time(); float(np.asarray(j(fresh())[0, 0]))
+    print(f"{name}: compile+1st {time.time()-t0:.0f}s", flush=True)
+    b = 9e9
+    for _ in range(3):
+        t0 = time.perf_counter(); float(np.asarray(j(fresh())[0, 0])); b = min(b, time.perf_counter()-t0)
+    print(f"{name}: wall {b*1e3:.0f} ms", flush=True)

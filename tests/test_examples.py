@@ -49,3 +49,14 @@ def test_qaoa_maxcut():
     r = _run("qaoa_maxcut.py", env_extra={"QT_QAOA_QUBITS": "6"})
     assert r.returncode == 0, r.stderr
     assert "expected cut" in r.stdout
+
+
+@pytest.mark.parametrize("mode", [[], ["--fused"]])
+def test_phase_estimation(mode):
+    # phi = 11/64 is exactly representable with 6 counting qubits, so the
+    # measured estimate is deterministic
+    r = _run("phase_estimation.py", *mode,
+             env_extra={"QPE_QUBITS": "6", "QPE_PHI": "0.171875"})
+    assert r.returncode == 0, r.stderr
+    assert "estimate" in r.stdout
+    assert "|error| = 0.0" in r.stdout

@@ -330,7 +330,7 @@ class TestChannelCapture:
                 qt.mixDepolarising(r, 1, p)
                 items = list(r._fusion.gates)
                 keys.append(fusion._plan_key(
-                    items, r.num_qubits_in_state_vec))
+                    items, r.num_qubits_in_state_vec, True))
         assert keys[0] == keys[1]
 
     def test_sharded_register_channel_capture(self):
@@ -373,3 +373,24 @@ class TestChannelCapture:
         qt.mixDepolarising(eager, 6, 0.2)
         np.testing.assert_allclose(np.asarray(fused.amps),
                                    np.asarray(eager.amps), atol=1e-12)
+
+    def test_channel_sweep_path(self, env, monkeypatch):
+        """With sweeps enabled (interpret opt-in on CPU), a noise layer on
+        a >= 15-bit register drains through apply_pair_channel_sweep and
+        matches the eager path."""
+        monkeypatch.setenv("QT_CHAN_SWEEP_INTERPRET", "1")
+        n = 8                              # nn = 16 >= 15
+        def prog(r):
+            qt.hadamard(r, 0)
+            for q in range(n):
+                qt.mixDepolarising(r, q, 0.04 + 0.01 * q)
+            qt.mixDamping(r, 2, 0.3)
+        fused = qt.createDensityQureg(n, env)
+        qt.initPlusState(fused)
+        with qt.gateFusion(fused):
+            prog(fused)
+        eager = qt.createDensityQureg(n, env)
+        qt.initPlusState(eager)
+        prog(eager)
+        np.testing.assert_allclose(np.asarray(fused.amps),
+                                   np.asarray(eager.amps), atol=1e-5)
