@@ -407,14 +407,25 @@ def _spans_mesh(qureg: Qureg) -> bool:
             and qureg.num_amps_total >= env.num_devices)
 
 
-def _sharded_tpu_register(qureg: Qureg) -> bool:
-    """_spans_mesh AND a real TPU backend.  The scan-based
-    Trotter/expectation paths run their product layers through raw Pallas
-    window kernels, which have no GSPMD partitioning rule — on a real
-    sharded TPU register those paths must fall back to the per-term
-    kernels (mirrors the _qft_fused guard; the virtual CPU mesh is fine
-    because kernels run in interpret mode there, partitioning as plain
-    XLA ops)."""
+def _explicit_sharded(qureg: Qureg) -> bool:
+    """Route to the explicit shard_map kernels: the register spans the
+    mesh and the explicit-collective layer is enabled (the default).
+    This is the ONE routing predicate for scan-based composites — the
+    same kernels run on the virtual CPU mesh and on real multi-chip TPU
+    meshes (one-kernel-set contract, QuEST_internal.h:63-292)."""
+    from .parallel import dist as PAR
+
+    return PAR.explicit_dist_enabled() and _spans_mesh(qureg)
+
+
+def _gspmd_pallas_unsafe(qureg: Qureg) -> bool:
+    """True when GSPMD propagation of raw Pallas kernels would fail: a
+    real TPU backend with the register actually spanning the mesh (a raw
+    pallas_call has no GSPMD partitioning rule there; the virtual CPU
+    mesh partitions interpret-mode kernels as plain XLA ops).  Only
+    consulted on the explicitly-opted-out GSPMD path
+    (dist.use_explicit_dist(False)) — the default explicit path has no
+    such fallback."""
     import jax as _jax
 
     return _jax.default_backend() == "tpu" and _spans_mesh(qureg)
@@ -464,21 +475,29 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
         val = P.calc_expec_pauli_sum_density(
             qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
         )
-    elif _sharded_tpu_register(qureg):
-        # per-term path: the scan's Pallas product layers cannot partition
-        # under GSPMD on a real multi-chip mesh (see _sharded_tpu_register)
+    elif _gspmd_pallas_unsafe(qureg) and not _explicit_sharded(qureg):
+        # opted-out GSPMD mode on a real TPU mesh: the scan's Pallas
+        # product layers cannot partition there — per-term kernels
         val = P.calc_expec_pauli_sum_statevec(
             qureg.amps, cj, num_qubits=n, codes_flat=codes,
             num_terms=num_terms,
         )
     else:
         # scan over the term table: one compiled body regardless of term
-        # count (the unrolled variant took ~100 s to compile at 16x24q)
+        # count (the unrolled variant took ~100 s to compile at 16x24q);
+        # sharded registers run the SAME scan inside one shard_map with
+        # explicit collectives (dist.expec_pauli_sum_scan_sharded)
         codes_seq = jnp.asarray(
             np.asarray(codes, np.int32).reshape(num_terms, n))
-        val = P.expec_pauli_sum_scan(
-            qureg.amps, codes_seq, jnp.asarray(cj), num_qubits=n
-        )
+        if _explicit_sharded(qureg):
+            from .parallel import dist as PAR
+            val = PAR.expec_pauli_sum_scan_sharded(
+                qureg.amps, codes_seq, jnp.asarray(cj),
+                mesh=qureg.env.mesh, num_qubits=n)
+        else:
+            val = P.expec_pauli_sum_scan(
+                qureg.amps, codes_seq, jnp.asarray(cj), num_qubits=n
+            )
     return float(val)
 
 
@@ -608,7 +627,8 @@ def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float, order: int
     if time == 0:
         return
     seq = _trotter_schedule(hamil.num_sum_terms, time, order, reps)
-    if qureg.qasm_log.is_logging or _sharded_tpu_register(qureg):
+    if qureg.qasm_log.is_logging or (
+            _gspmd_pallas_unsafe(qureg) and not _explicit_sharded(qureg)):
         # per-term path so every rotation is QASM-logged.  NOTE:
         # deliberately NOT wrapped in fusion.gate_fusion — the per-term
         # parity phase forces a drain every ~36 rotations, and the
@@ -627,6 +647,18 @@ def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float, order: int
     facs = np.asarray([f for _, f in seq])
     codes_seq = np.asarray(hamil.pauli_codes)[t_idx].astype(np.int32)
     angles = 2.0 * facs * np.asarray(hamil.term_coeffs, np.float64)[t_idx]
+    if _explicit_sharded(qureg):
+        # same scan inside one shard_map: per-shard window layers +
+        # ppermute exchange for sharded qubits (one-kernel-set contract
+        # on real multi-chip meshes)
+        from .parallel import dist as PAR
+        qureg.amps = PAR.trotter_scan_sharded(
+            qureg.amps, jnp.asarray(codes_seq), jnp.asarray(angles),
+            mesh=qureg.env.mesh,
+            num_qubits=qureg.num_qubits_in_state_vec,
+            rep_qubits=qureg.num_qubits_represented,
+        )
+        return
     qureg.amps = P.trotter_scan(
         qureg.amps, jnp.asarray(codes_seq), jnp.asarray(angles),
         num_qubits=qureg.num_qubits_in_state_vec,
@@ -887,13 +919,14 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
     Sharded registers: a FULL-register statevector QFT runs as ONE
     explicit shard_map program (dist.fused_qft_sharded — ppermute H
     exchanges for mesh-bit layers, the same Pallas ladder kernels
-    per-shard for local layers, and an all_to_all bit-reversal), so the
-    fused kernel set now runs on real TPU meshes too
-    (QuEST_internal.h:63-292 one-kernel-set contract).  Partial-run or
-    density QFTs on a sharded register ride GSPMD on the virtual CPU
-    mesh (interpret-mode kernels partition as plain XLA ops) and take
-    the layered path on a real multi-chip TPU mesh (a raw pallas_call
-    has no GSPMD partitioning rule)."""
+    per-shard for local layers, and an all_to_all bit-reversal); partial
+    and density QFTs run the general-run shard_map kernel
+    (dist.fused_qft_runs_sharded), so the fused kernel set runs on real
+    TPU meshes for EVERY QFT shape (QuEST_internal.h:63-292
+    one-kernel-set contract).  Only the explicitly-opted-out GSPMD mode
+    (dist.use_explicit_dist(False)) retains a layered-path fallback on
+    real multi-chip TPU meshes (a raw pallas_call has no GSPMD
+    partitioning rule)."""
     import jax as _jax
 
     from quest_tpu import circuit as CIRC
@@ -919,7 +952,22 @@ def _qft_fused(qureg: Qureg, qubits) -> bool:
                 qureg.amps, mesh=env.mesh, num_qubits=nsv)
             _qft_qasm_trail(qureg, qubits, nt)
             return True
+        if PAR.explicit_dist_enabled():
+            # partial-register / density QFT on a sharded register: the
+            # general-run shard_map kernel (fully-local runs execute the
+            # unsharded fused kernels per shard; runs reaching mesh bits
+            # use ppermute layers + the mixed bit reversal)
+            runs = [(start, nt, False)]
+            if qureg.is_density_matrix:
+                runs.append((start + _shift(qureg), nt, True))
+            qureg.amps = PAR.fused_qft_runs_sharded(
+                qureg.amps, mesh=env.mesh, num_qubits=nsv,
+                runs=tuple(runs))
+            _qft_qasm_trail(qureg, qubits, nt)
+            return True
         if _jax.default_backend() == "tpu":
+            # opted-out GSPMD mode cannot partition the raw Pallas
+            # kernels on a real mesh: layered path
             return False
 
     shifts = [0, _shift(qureg)] if qureg.is_density_matrix else [0]

@@ -1307,7 +1307,8 @@ def _qft_layer_dense(tr: int, conj: bool, dt) -> np.ndarray:
 
 def fused_qft(amps, num_qubits: int, start: int, count: int,
               shifts: Sequence[int] = (0,),
-              interpret: Optional[bool] = None):
+              interpret: Optional[bool] = None,
+              conj_first: bool = False):
     """QFT on the contiguous qubits [start, start+count) — plus a
     conjugated twin per extra entry of ``shifts`` (the density-matrix bra
     half) — as:
@@ -1332,10 +1333,11 @@ def fused_qft(amps, num_qubits: int, start: int, count: int,
     dt = np.float64 if amps.dtype == jnp.float64 else np.float32
     if (start == 0 and tuple(shifts) == (0,) and count >= 15
             and fused.qft_multilayer_enabled(amps.dtype)):
-        return _fused_qft_multilayer(amps, n, count, interpret)
+        return _fused_qft_multilayer(amps, n, count, interpret,
+                                     conj=conj_first)
     dense_gates: List[Gate] = []
     for si, sh in enumerate(shifts):
-        conj = si > 0
+        conj = si > 0 or conj_first
         base = start + sh
         for qq in range(count - 1, -1, -1):
             if qq >= LANE:
@@ -1363,7 +1365,7 @@ def fused_qft(amps, num_qubits: int, start: int, count: int,
 
 
 def _fused_qft_multilayer(amps, n: int, count: int,
-                          interpret: Optional[bool]):
+                          interpret: Optional[bool], conj: bool = False):
     """Radix-2^k QFT (full or [0, count) run of a statevector register):
 
       * layers t >= 14 in chunks of QT_QFT_RADIX (default 4) per HBM
@@ -1383,8 +1385,8 @@ def _fused_qft_multilayer(amps, n: int, count: int,
     QuEST_common.c:836-898)."""
     dt = np.float64 if amps.dtype == jnp.float64 else np.float32
     amps = fused.apply_qft_multilayer_ladders(
-        amps, num_qubits=n, t_top=count - 1, interpret=interpret)
-    dense_gates = [Gate(tuple(range(qq + 1)), _qft_layer_dense(qq, False, dt))
+        amps, num_qubits=n, t_top=count - 1, conj=conj, interpret=interpret)
+    dense_gates = [Gate(tuple(range(qq + 1)), _qft_layer_dense(qq, conj, dt))
                    for qq in range(LANE - 1, -1, -1)]
     rev7 = _rev_perm_mat(LANE, dt)
     dense_gates.append(Gate(tuple(range(LANE)), rev7))

@@ -228,6 +228,57 @@ def _product_layer(amps, mats, n):
     return amps
 
 
+def make_trotter_body(dt, nq: int, is_density: bool, layer, parity_phase):
+    """The per-term Trotter scan body (rotate -> parity phase [+ bra
+    twin] -> unrotate), parameterized by the layer applier
+    ``layer(carry, mats)`` and the parity phase
+    ``parity_phase(carry, theta, zlo, zhi)`` so the unsharded scan
+    (trotter_scan) and the shard_map scan
+    (parallel.dist.trotter_scan_sharded) share ONE body — including the
+    non-obvious all-identity-term angle zeroing (such terms contribute
+    only a global phase the unfused path skips)."""
+    tab, tabd, tabc, tabcd = _rot_tables(dt)
+
+    def mats_for(codes, t, tc):
+        m = t[codes]                        # (nq, 2, 2, 2)
+        if is_density:
+            m = jnp.concatenate([m, tc[codes]], axis=0)
+        return m
+
+    def body(carry, inp):
+        codes, ang = inp
+        ang = ang.astype(dt)
+        carry = layer(carry, mats_for(codes, tab, tabc))
+        zlo, zhi = _zmask_halves(codes, 0, nq)
+        theta = jnp.where((zlo | zhi) == 0, jnp.asarray(0.0, dt), ang)
+        carry = parity_phase(carry, theta, zlo, zhi)
+        if is_density:
+            blo, bhi = _zmask_halves(codes, nq, nq)
+            carry = parity_phase(carry, -theta, blo, bhi)
+        carry = layer(carry, mats_for(codes, tabd, tabcd))
+        return carry, None
+
+    return body
+
+
+def make_expec_term_value(dt, n: int, layer, signed_norm):
+    """The per-term PauliSum expectation body: basis-rotate a copy of the
+    state (``layer``), then reduce the parity-signed norm
+    (``signed_norm(phi, zlo, zhi)``).  Shared by expec_pauli_sum_scan and
+    parallel.dist.expec_pauli_sum_scan_sharded."""
+    tab, _, _, _ = _rot_tables(dt)
+
+    def body_of(amps):
+        def body(acc, inp):
+            codes, coeff = inp
+            phi = layer(amps, tab[codes])
+            zlo, zhi = _zmask_halves(codes, 0, n)
+            return acc + coeff.astype(dt) * signed_norm(phi, zlo, zhi), None
+        return body
+
+    return body_of
+
+
 @partial(jax.jit, static_argnames=("num_qubits", "rep_qubits"),
          donate_argnums=0)
 def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
@@ -240,33 +291,13 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
     whose first-call compile took minutes at config-5 scale
     (agnostic_applyTrotterCircuit, QuEST_common.c:752-834)."""
     n, nq = num_qubits, rep_qubits
-    is_density = n == 2 * nq
     dt = amps.dtype
-    tab, tabd, tabc, tabcd = _rot_tables(dt)
-
-    def mats_for(codes, t, tc):
-        m = t[codes]                        # (nq, 2, 2, 2)
-        if is_density:
-            m = jnp.concatenate([m, tc[codes]], axis=0)
-        return m
-
-    def body(carry, inp):
-        codes, ang = inp
-        ang = ang.astype(dt)
-        mats = mats_for(codes, tab, tabc)
-        carry = _product_layer(carry, mats, n)
-        zlo, zhi = _zmask_halves(codes, 0, nq)
-        # all-identity terms contribute only a global phase the unfused
-        # path skips; match it by zeroing the angle
-        theta = jnp.where((zlo | zhi) == 0, jnp.asarray(0.0, dt), ang)
-        carry = _parity_phase_mask(carry, theta, zlo, zhi, n)
-        if is_density:
-            blo, bhi = _zmask_halves(codes, nq, nq)
-            carry = _parity_phase_mask(carry, -theta, blo, bhi, n)
-        matsd = mats_for(codes, tabd, tabcd)
-        carry = _product_layer(carry, matsd, n)
-        return carry, None
-
+    body = make_trotter_body(
+        dt, nq, n == 2 * nq,
+        layer=lambda carry, mats: _product_layer(carry, mats, n),
+        parity_phase=lambda carry, theta, zlo, zhi: _parity_phase_mask(
+            carry, theta, zlo, zhi, n),
+    )
     amps, _ = jax.lax.scan(body, amps, (codes_seq, angles))
     return amps
 
@@ -282,17 +313,16 @@ def expec_pauli_sum_scan(amps, codes_seq, coeffs, *, num_qubits: int):
     at 16 terms x 24 qubits."""
     n = num_qubits
     dt = amps.dtype
-    tab, _, _, _ = _rot_tables(dt)
 
-    def body(acc, inp):
-        codes, coeff = inp
-        mats = tab[codes]
-        phi = _product_layer(amps, mats, n)
-        zlo, zhi = _zmask_halves(codes, 0, n)
+    def signed_norm(phi, zlo, zhi):
         s = _parity_sign_dynamic(zlo, zhi, n, dt)
-        val = jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
-        return acc + coeff.astype(dt) * val, None
+        return jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
 
+    body = make_expec_term_value(
+        dt, n,
+        layer=lambda a, mats: _product_layer(a, mats, n),
+        signed_norm=signed_norm,
+    )(amps)
     total, _ = jax.lax.scan(body, jnp.zeros((), dt), (codes_seq, coeffs))
     return total
 

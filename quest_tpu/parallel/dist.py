@@ -292,6 +292,158 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     )(amps, jnp.asarray(prob, dt))
 
 
+def _apply_1q_mesh_bit(local, m, bit: int, ndev: int):
+    """Dense 1q gate on mesh-coordinate bit ``bit`` INSIDE a shard_map body:
+    one full-shard ppermute + fused elementwise combine — the
+    apply_matrix_1q_sharded kernel body factored out so scan-based
+    composites (Trotter, PauliSum expectation) can apply rotation layers
+    to sharded qubits with the same exchange pattern the reference's
+    distributed compactUnitary uses (QuEST_cpu_distributed.c:854-928).
+    ``m`` may be a TRACED (2, 2, 2) SoA matrix (e.g. indexed by a scanned
+    Pauli code): an identity simply combines with b-coefficients of zero —
+    the ppermute still happens, matching the reference, whose distributed
+    basis rotations also exchange regardless of the rotation angle."""
+    idx = lax.axis_index(AMP_AXIS)
+    mybit = (idx >> bit) & 1
+    recv = lax.ppermute(local, AMP_AXIS, _hypercube_perm(ndev, bit))
+    a_re, a_im, b_re, b_im = _shard_coeffs(m, mybit)
+    return cplx.cmul(local, a_re, a_im) + cplx.cmul(recv, b_re, b_im)
+
+
+def _split_parity_mask(zlo, zhi, nloc: int, r: int):
+    """Split TRACED uint32 z-mask halves over global state bits (lo =
+    bits [0,31), hi = bits [31,62) — ops/paulis.py convention) at the
+    static local/shard boundary ``nloc``: returns (local_lo, local_hi,
+    shard_mask) where shard_mask bit j corresponds to global bit
+    nloc + j.  Parity factorises over the split, so a global parity sign
+    is the product of a per-shard scalar sign and the local sign."""
+    from ..ops.paulis import _PAR_LO_BITS as _L
+
+    if nloc <= _L:
+        loc_lo = zlo & jnp.uint32((1 << nloc) - 1)
+        loc_hi = jnp.uint32(0)
+        sm = zlo >> nloc
+        if nloc + r > _L:
+            sm = sm | (zhi << (_L - nloc))
+    else:
+        loc_lo = zlo
+        loc_hi = zhi & jnp.uint32((1 << (nloc - _L)) - 1)
+        sm = zhi >> (nloc - _L)
+    return loc_lo, loc_hi, sm & jnp.uint32((1 << r) - 1)
+
+
+def _shard_parity_sign(shard_mask, dt):
+    """(+1/-1) scalar sign of parity(shard_index & shard_mask)."""
+    idx = lax.axis_index(AMP_AXIS).astype(jnp.uint32)
+    odd = lax.population_count(idx & shard_mask) & jnp.uint32(1)
+    return 1.0 - 2.0 * odd.astype(dt)
+
+
+def _parity_phase_sharded(local, theta, zlo, zhi, nloc: int, r: int):
+    """exp(-i theta/2 (-1)^parity(global_idx & zmask)) per shard — the
+    sharded form of ops/paulis._parity_phase_mask: the global parity sign
+    is the local-index sign times a per-shard scalar."""
+    from ..ops import paulis as _paulis
+
+    loc_lo, loc_hi, sm = _split_parity_mask(zlo, zhi, nloc, r)
+    s_loc = _paulis._parity_sign_dynamic(loc_lo, loc_hi, nloc, local.dtype)
+    s_sh = _shard_parity_sign(sm, local.dtype)
+    ang = -0.5 * theta
+    return cplx.cmul(local, jnp.cos(ang), jnp.sin(ang) * s_sh * s_loc)
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "rep_qubits"),
+         donate_argnums=0)
+def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
+                         num_qubits: int, rep_qubits: int):
+    """The whole Trotter gate stream on a SHARDED register as ONE
+    shard_map(lax.scan) program — the same one-compiled-term-body design
+    as ops/paulis.trotter_scan, with the per-term basis-rotation layers
+    applying local qubits through the per-shard window kernels and
+    mesh-coordinate qubits through explicit ppermute exchange
+    (_apply_1q_mesh_bit), and the parity phase split into local x
+    per-shard-scalar signs.  This makes the one-kernel-set contract
+    (QuEST_internal.h:63-292) hold for applyTrotterCircuit on real
+    multi-chip meshes: the reference's agnostic_applyTrotterCircuit
+    (QuEST_common.c:752-834) likewise rides the same distributed kernels.
+
+    Collectives: exactly 2*r ppermutes per scanned term (rotate +
+    unrotate layer, one per sharded qubit), nothing else."""
+    from ..ops import paulis as _paulis
+
+    n, nq = num_qubits, rep_qubits
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = n - r
+    dt = amps.dtype
+
+    def layer(local, mats):
+        local = _paulis._product_layer(local, mats[:nloc], nloc)
+        for q in range(nloc, n):
+            local = _apply_1q_mesh_bit(local, mats[q], q - nloc, ndev)
+        return local
+
+    def kernel(local, codes_seq, angles):
+        body = _paulis.make_trotter_body(
+            dt, nq, n == 2 * nq, layer=layer,
+            parity_phase=lambda carry, theta, zlo, zhi:
+                _parity_phase_sharded(carry, theta, zlo, zhi, nloc, r),
+        )
+        out, _ = jax.lax.scan(body, local, (codes_seq, angles))
+        return out
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, AMP_AXIS), P(), P()),
+        out_specs=P(None, AMP_AXIS), check_vma=False,
+    )(amps, codes_seq, angles)
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits"))
+def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
+                                 num_qubits: int):
+    """Re <psi| sum_t c_t P_t |psi> on a SHARDED statevector as ONE
+    shard_map(lax.scan) — the sharded form of
+    ops/paulis.expec_pauli_sum_scan: per term, basis-rotate per shard
+    (ppermute for sharded qubits), reduce the parity-signed norm locally
+    with the shard-scalar sign factored out, and psum ONCE at the end
+    (the reference's local-reduce + MPI_Allreduce,
+    QuEST_cpu_distributed.c:35-51).
+
+    Collectives: r ppermutes per scanned term + one all-reduce total."""
+    from ..ops import paulis as _paulis
+
+    n = num_qubits
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = n - r
+    dt = amps.dtype
+
+    def layer(local, mats):
+        phi = _paulis._product_layer(local, mats[:nloc], nloc)
+        for q in range(nloc, n):
+            phi = _apply_1q_mesh_bit(phi, mats[q], q - nloc, ndev)
+        return phi
+
+    def signed_norm(phi, zlo, zhi):
+        loc_lo, loc_hi, sm = _split_parity_mask(zlo, zhi, nloc, r)
+        s = _paulis._parity_sign_dynamic(loc_lo, loc_hi, nloc, dt)
+        s_sh = _shard_parity_sign(sm, dt)
+        return s_sh * jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
+
+    def kernel(local, codes_seq, coeffs):
+        body = _paulis.make_expec_term_value(
+            dt, n, layer=layer, signed_norm=signed_norm)(local)
+        tot, _ = jax.lax.scan(body, jnp.zeros((), dt), (codes_seq, coeffs))
+        return lax.psum(tot, AMP_AXIS)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, AMP_AXIS), P(), P()),
+        out_specs=P(), check_vma=False,
+    )(amps, codes_seq, coeffs)
+
+
 def _ladder_phase_chunks(nbits: int, t_eff: int, sgn: float, dt):
     """Host tables factorizing exp(sgn*i*pi*li / 2^t_eff) over 7-bit chunks
     of the ``nbits``-bit index li (an exponential of a sum of per-bit
@@ -310,18 +462,49 @@ def _ladder_phase_chunks(nbits: int, t_eff: int, sgn: float, dt):
     return out
 
 
-def _apply_local_phase(local, chunks):
-    """Elementwise multiply by the factored phase over the local index."""
+def _apply_local_phase(local, chunks, skip: int = 0):
+    """Elementwise multiply by the factored phase over the local index
+    bits [skip, nloc) — ``skip`` > 0 leaves a trailing untouched 2^skip
+    axis (partial-run ladders whose low end starts above bit 0)."""
     widths = [w for w, _ in chunks]
     shape = [2] + [1 << w for w in reversed(widths)]
+    if skip:
+        shape.append(1 << skip)
     v = local.reshape(shape)
     ndim = len(shape) - 1
+    off = 1 if skip else 0
     for ci, (w, tab) in enumerate(chunks):
         bshape = [1] * ndim
-        bshape[ndim - 1 - ci] = 1 << w
+        bshape[ndim - 1 - ci - off] = 1 << w
         v = cplx.cmul(v, jnp.asarray(tab[0]).reshape(bshape),
                       jnp.asarray(tab[1]).reshape(bshape))
     return v.reshape(local.shape)
+
+
+def _qft_mesh_layer(local, idx, t: int, base: int, nloc: int, ndev: int,
+                    sgn: float, dt):
+    """One mesh-bit QFT layer (target t >= nloc) inside a shard_map body:
+    full-shard ppermute H-exchange (the reference's pairwise exchange,
+    QuEST_cpu_distributed.c:854-928) + the controlled-phase ladder over
+    run bits [base, t), its phase split into a per-shard scalar (the
+    sharded ladder bits) times factored local tables.  Shared by
+    fused_qft_sharded (base = 0) and fused_qft_runs_sharded (any base)."""
+    bit = t - nloc
+    mybit = (idx >> bit) & 1
+    recv = lax.ppermute(local, AMP_AXIS, _hypercube_perm(ndev, bit))
+    s = jnp.where(mybit == 0, jnp.asarray(1.0, dt), jnp.asarray(-1.0, dt))
+    comb = (local * s + recv) * jnp.asarray(0.7071067811865476, dt)
+    sb = max(base - nloc, 0)       # shard-bit start of the ladder
+    width = bit - sb
+    ph = comb
+    if base < nloc:
+        chunks = _ladder_phase_chunks(nloc - base, t - base, sgn, dt)
+        ph = _apply_local_phase(ph, chunks, skip=base)
+    if width:
+        mlow = ((idx >> sb) & ((1 << width) - 1)).astype(dt)
+        theta = jnp.asarray(sgn * math.pi, dt) * mlow / (1 << width)
+        ph = cplx.cmul(ph, jnp.cos(theta), jnp.sin(theta))
+    return jnp.where(mybit == 1, ph, comb)
 
 
 @partial(jax.jit, static_argnames=("mesh", "num_qubits", "conj"),
@@ -355,35 +538,15 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
     nloc = n - r
     dt = amps.dtype
     sgn = -1.0 if conj else 1.0
-    inv = 0.7071067811865476
     use_multilayer = (_fused.qft_multilayer_enabled(dt)
                       and nloc >= _fused.CLUSTER_QUBITS + 1)
     radix = _fused._qft_radix()
 
-    # host-precomputed local phase tables per mesh layer
-    layer_chunks = {
-        t: _ladder_phase_chunks(nloc, t, sgn, dt)
-        for t in range(nloc, n)
-    }
-
     def kernel(local):
         idx = lax.axis_index(AMP_AXIS)
-        # mesh-bit layers, high to low
+        # mesh-bit layers, high to low (shared helper — see _qft_mesh_layer)
         for t in range(n - 1, nloc - 1, -1):
-            bit = t - nloc
-            perm = _hypercube_perm(ndev, bit)
-            mybit = (idx >> bit) & 1
-            recv = lax.ppermute(local, AMP_AXIS, perm)
-            s = jnp.where(mybit == 0, jnp.asarray(1.0, dt),
-                          jnp.asarray(-1.0, dt))
-            comb = (local * s + recv) * jnp.asarray(inv, dt)
-            # ladder phase on the |1> half (mybit == 1 shards): scalar
-            # from the sharded low bits x factored local tables
-            mlow = (idx & ((1 << bit) - 1)).astype(dt)
-            theta = jnp.asarray(sgn * math.pi, dt) * mlow / (1 << bit)
-            ph = _apply_local_phase(comb, layer_chunks[t])
-            ph = cplx.cmul(ph, jnp.cos(theta), jnp.sin(theta))
-            local = jnp.where(mybit == 1, ph, comb)
+            local = _qft_mesh_layer(local, idx, t, 0, nloc, ndev, sgn, dt)
         # local layers, per shard: multilayer (radix-2^k) passes when the
         # shard is big enough — the SAME grouping helper the unsharded
         # path uses (fused.apply_qft_multilayer_ladders) — else per-layer
@@ -420,6 +583,114 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
         else:
             perm = tuple(nloc - 1 - q for q in range(nloc))
             local = kernels.permute_qubits(local, num_qubits=nloc, perm=perm)
+        return local
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=P(None, AMP_AXIS),
+        out_specs=P(None, AMP_AXIS), check_vma=False,
+    )(amps)
+
+
+def _reverse_run_sharded(local, base: int, count: int, nloc: int,
+                         ndev: int):
+    """Bit reversal of the contiguous run [base, base+count) of a sharded
+    register, inside a shard_map body.  The reversal is a set of disjoint
+    bit swaps (base+i <-> base+count-1-i); each class costs:
+
+      * local-local  : folded into ONE per-shard axis permutation;
+      * mesh-mesh    : folded into ONE composed full-shard ppermute
+        (a pure shard-index permutation);
+      * local-mesh   : one half-shard ppermute each (the swap_sharded
+        exchange: only the mismatched half moves,
+        QuEST_cpu_distributed.c:1397-1436).
+    """
+    top = base + count
+    perm_local = list(range(nloc))
+    mesh_pairs = []
+    mixed = []
+    for i in range(count // 2):
+        p, q = base + i, top - 1 - i
+        if q < nloc:
+            perm_local[p], perm_local[q] = perm_local[q], perm_local[p]
+        elif p >= nloc:
+            mesh_pairs.append((p - nloc, q - nloc))
+        else:
+            mixed.append((p, q - nloc))
+    if perm_local != list(range(nloc)):
+        local = kernels.permute_qubits(local, num_qubits=nloc,
+                                       perm=tuple(perm_local))
+    if mesh_pairs:
+        def sig(i):
+            j = i
+            for a, b in mesh_pairs:
+                ba, bb = (i >> a) & 1, (i >> b) & 1
+                j = (j & ~((1 << a) | (1 << b))) | (ba << b) | (bb << a)
+            return j
+
+        local = lax.ppermute(local, AMP_AXIS,
+                             [(i, sig(i)) for i in range(ndev)])
+    for lb, mb in mixed:
+        idx = lax.axis_index(AMP_AXIS)
+        u = (idx >> mb) & 1
+        lv = local.reshape(2, 1 << (nloc - 1 - lb), 2, 1 << lb)
+        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
+        recv = lax.ppermute(send, AMP_AXIS, _hypercube_perm(ndev, mb))
+        local = lax.dynamic_update_index_in_dim(
+            lv, recv, 1 - u, axis=2).reshape(2, -1)
+    return local
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "runs"),
+         donate_argnums=0)
+def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
+                           runs: Tuple[Tuple[int, int, bool], ...]):
+    """QFT over contiguous qubit runs [(base, count, conj), ...] of a
+    SHARDED register, one shard_map end to end — the general-run
+    companion of fused_qft_sharded covering partial-register QFTs and the
+    density-matrix twin (runs = ket run + conjugated bra run), so
+    applyQFT / applyFullQFT run the SAME fused kernel set on real
+    multi-chip meshes instead of falling back to the layered path
+    (one-kernel-set contract, QuEST_internal.h:63-292; reference
+    agnostic_applyQFT, QuEST_common.c:836-898).
+
+    Per run: a FULLY-LOCAL run executes circuit.fused_qft per shard —
+    identical multilayer/window passes to the unsharded path; a run
+    reaching mesh-coordinate bits runs ppermute H-exchange layers
+    (one full-shard ppermute each, phase split into per-shard scalar x
+    factored local tables), per-shard ladder kernels for its local
+    layers, and the mixed bit reversal of _reverse_run_sharded.
+
+    Collectives for a run with s sharded bits: s ppermutes (layers) +
+    at most s reversal ppermutes; fully-local runs cost zero."""
+    from .. import circuit as CIRC
+
+    n = num_qubits
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = n - r
+    dt = amps.dtype
+
+    def kernel(local):
+        idx = lax.axis_index(AMP_AXIS)
+        for base, count, conj in runs:
+            top = base + count
+            sgn = -1.0 if conj else 1.0
+            if top <= nloc and nloc >= CIRC.WINDOW:
+                # fully-local run on a window-sized shard: the unsharded
+                # fused kernels per shard (shards below window size use
+                # the per-layer ladder path below instead)
+                local = CIRC.fused_qft(local, nloc, base, count,
+                                       shifts=(0,), conj_first=conj)
+                continue
+            # mesh-bit layers, top down (shared helper, _qft_mesh_layer)
+            for t in range(top - 1, max(base, nloc) - 1, -1):
+                local = _qft_mesh_layer(local, idx, t, base, nloc, ndev,
+                                        sgn, dt)
+            # local layers per shard (same ladder kernels as unsharded)
+            for t in range(min(top, nloc) - 1, base - 1, -1):
+                local = kernels.apply_qft_ladder(
+                    local, num_qubits=nloc, target=t, base=base, conj=conj)
+            local = _reverse_run_sharded(local, base, count, nloc, ndev)
         return local
 
     return shard_map(

@@ -197,3 +197,128 @@ def test_explicit_pair_channels_vs_oracle(env, kind, target):
         K = oracle.full_operator(n, [target], k)
         expect = expect + K @ mat @ K.conj().T
     np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# Scan-based composites + general-run QFT: one kernel set on sharded meshes
+# (VERDICT r3 item 1 — the paths that used to bail to per-term/layered)
+# ---------------------------------------------------------------------------
+
+
+def _rand_hamil(qt_mod, n, nterms, rng):
+    codes = rng.integers(0, 4, size=(nterms, n))
+    coeffs = rng.normal(size=nterms)
+    h = qt_mod.createPauliHamil(n, nterms)
+    qt_mod.initPauliHamil(h, coeffs, codes.ravel())
+    return h, codes, coeffs
+
+
+def _hamil_matrix(n, codes, coeffs):
+    mats = [oracle.I2, oracle.X, oracle.Y, oracle.Z]
+    H = np.zeros((1 << n, 1 << n), complex)
+    for t in range(codes.shape[0]):
+        term = np.eye(1)
+        for q in range(n - 1, -1, -1):
+            term = np.kron(term, mats[codes[t, q]])
+        H = H + coeffs[t] * term
+    return H
+
+
+def test_trotter_scan_sharded_vs_oracle(env):
+    """applyTrotterCircuit on a sharded statevector runs the shard_map
+    scan (dist.trotter_scan_sharded) and must match the dense
+    first-order product-formula oracle."""
+    n = 6
+    rng = np.random.default_rng(71)
+    q, vec = _rand_psi(env, rng, n)
+    h, codes, coeffs = _rand_hamil(qt, n, 3, rng)
+    t, reps = 0.21, 2
+    qt.applyTrotterCircuit(q, h, t, 1, reps)
+    expect = vec
+    for _ in range(reps):
+        for k in range(codes.shape[0]):
+            term = _hamil_matrix(n, codes[k:k + 1], coeffs[k:k + 1])
+            from scipy.linalg import expm
+            expect = expm(-1j * term * (t / reps)) @ expect
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect,
+                               atol=1e-10)
+
+
+def test_trotter_scan_sharded_density(env):
+    """Sharded density-matrix Trotter (bra twin layers included) matches
+    the unitary-conjugation oracle."""
+    n = 4
+    rng = np.random.default_rng(72)
+    mat = oracle.random_density(n, rng)
+    r = qt.createDensityQureg(n, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    h, codes, coeffs = _rand_hamil(qt, n, 2, rng)
+    t = 0.4
+    qt.applyTrotterCircuit(r, h, t, 1, 1)
+    from scipy.linalg import expm
+    expect = mat
+    for k in range(codes.shape[0]):
+        term = _hamil_matrix(n, codes[k:k + 1], coeffs[k:k + 1])
+        U = expm(-1j * term * t)
+        expect = U @ expect @ U.conj().T
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect,
+                               atol=1e-10)
+
+
+def test_expec_pauli_sum_sharded_vs_oracle(env):
+    """calcExpecPauliHamil on a sharded statevector runs the shard_map
+    scan (dist.expec_pauli_sum_scan_sharded) and must match <psi|H|psi>."""
+    n = 6
+    rng = np.random.default_rng(73)
+    q, vec = _rand_psi(env, rng, n)
+    h, codes, coeffs = _rand_hamil(qt, n, 5, rng)
+    got = qt.calcExpecPauliHamil(q, h)
+    H = _hamil_matrix(n, codes, coeffs)
+    expect = float(np.real(vec.conj() @ H @ vec))
+    assert abs(got - expect) < 1e-10
+
+
+@pytest.mark.parametrize("start,count", [(0, 4), (0, 6), (7, 5), (11, 3)])
+def test_partial_qft_sharded_vs_oracle(env, start, count):
+    """applyQFT on a sub-run of a sharded register routes through
+    dist.fused_qft_runs_sharded (when the register is window-sized the
+    fused path engages; below it the layered path runs — both must match
+    the dense DFT oracle embedded on the run)."""
+    n = 14 if start else 6
+    rng = np.random.default_rng(74 + start + count)
+    q, vec = _rand_psi(env, rng, n)
+    qt.applyQFT(q, list(range(start, start + count)))
+    D = oracle.dft_matrix(count)
+    expect = oracle.full_operator(
+        n, list(range(start, start + count)), D) @ vec
+    np.testing.assert_allclose(oracle.state_from_qureg(q), expect,
+                               atol=1e-10)
+
+
+def test_density_full_qft_sharded_vs_oracle(env):
+    """applyFullQFT on a sharded density matrix (ket run + conjugated
+    bra run through dist.fused_qft_runs_sharded) equals F rho F^dag."""
+    n = 4
+    rng = np.random.default_rng(77)
+    mat = oracle.random_density(n, rng)
+    r = qt.createDensityQureg(n, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    qt.applyFullQFT(r)
+    F = oracle.dft_matrix(n)
+    np.testing.assert_allclose(oracle.state_from_qureg(r),
+                               F @ mat @ F.conj().T, atol=1e-10)
+
+
+def test_runs_sharded_window_sized_register(env):
+    """The general-run kernel on a register large enough for the fused
+    window path (18 state bits over 8 devices -> nloc = 15): density
+    full QFT vs the DFT oracle — run 1 executes circuit.fused_qft per
+    shard, run 2 the ppermute mesh layers + mixed reversal."""
+    n = 9
+    r = qt.createDensityQureg(n, env)
+    qt.initDebugState(r)
+    mat0 = oracle.state_from_qureg(r)
+    qt.applyFullQFT(r)
+    F = oracle.dft_matrix(n)
+    np.testing.assert_allclose(oracle.state_from_qureg(r),
+                               F @ mat0 @ F.conj().T, atol=1e-9)
