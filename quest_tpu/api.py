@@ -147,6 +147,9 @@ def reportState(qureg: Qureg) -> None:
 
 def reportStateToScreen(qureg: Qureg, env=None, reportRank: int = 0) -> None:
     """Print all amplitudes to stdout (QuEST.h:1289)."""
+    from .debug import _guard_host_gather
+
+    _guard_host_gather(qureg, "reportStateToScreen")
     amps = np.asarray(qureg.amps)
     print("Reporting state from rank 0:")
     for re, im in zip(amps[0], amps[1]):
@@ -403,15 +406,18 @@ def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
     """Overwrite a contiguous range of amplitudes (QuEST.h:1537)."""
     V.validate_state_vector(qureg, "setAmps")
     V.validate_num_amps(qureg, startInd, numAmps, "setAmps")
+    from .ops import element as E
+
     vals = np.stack(
         [
             np.asarray(reals, dtype=np.float64)[:numAmps],
             np.asarray(imags, dtype=np.float64)[:numAmps],
         ]
-    )
-    qureg.amps = qureg.amps.at[:, startInd:startInd + numAmps].set(
-        vals.astype(qureg.dtype)
-    )
+    ).astype(qureg.dtype)
+    # layout-safe ranged write: tile-aligned block updates + edge tiles,
+    # never the eager .at[].set() whose gather relayouts a canonically-
+    # held big state (ops/element.py)
+    qureg.amps = E.set_amp_range(qureg.amps, int(startInd), vals)
 
 
 def setDensityAmps(qureg: Qureg, reals, imags) -> None:

@@ -112,14 +112,28 @@ def measure(qureg: Qureg, measureQubit: int) -> int:
 
 
 def measureWithStats(qureg: Qureg, measureQubit: int):
-    """Measure one qubit, also returning the outcome probability (QuEST.h:3219)."""
+    """Measure one qubit, also returning the outcome probability
+    (QuEST.h:3219).  Default: ONE fused device program per shot — prob
+    reduce, on-device threshold draw from the seeded key, conditional
+    collapse (ops/measurement.py).  QT_HOST_MEASURE=1 (or strict parity
+    mode) restores the reference's host-MT sampling stream
+    (calcProb -> generateMeasurementOutcome -> collapse)."""
     V.validate_target(qureg, measureQubit, "measureWithStats")
-    zero_prob = calcProbOfOutcome(qureg, measureQubit, 0)
-    outcome = _generate_measurement_outcome(zero_prob)
-    prob = zero_prob if outcome == 0 else 1 - zero_prob
-    _collapse(qureg, measureQubit, outcome, prob)
+    from .ops import measurement as M
+    if M.host_path_enabled():
+        zero_prob = calcProbOfOutcome(qureg, measureQubit, 0)
+        outcome = _generate_measurement_outcome(zero_prob)
+        prob = zero_prob if outcome == 0 else 1 - zero_prob
+        _collapse(qureg, measureQubit, outcome, prob)
+        qureg.qasm_log.measure(measureQubit)
+        return outcome, prob
+    key, shot = M.KEYS.next_shots()
+    amps, outcome, prob = M.measure_fused(
+        qureg.amps, key, shot, num_qubits=qureg.num_qubits_represented,
+        target=measureQubit, is_density=qureg.is_density_matrix)
+    qureg.amps = amps
     qureg.qasm_log.measure(measureQubit)
-    return outcome, prob
+    return int(outcome), float(prob)
 
 
 # ---------------------------------------------------------------------------
@@ -310,10 +324,15 @@ def mixMultiQubitKrausMap(qureg: Qureg, targets: Sequence[int], ops, numOps: Opt
 
 
 def getAmp(qureg: Qureg, index: int) -> complex:
-    """Fetch one complex amplitude (QuEST.h:1987)."""
+    """Fetch one complex amplitude (QuEST.h:1987).  Routed through the
+    layout-safe dynamic-slice kernel (ops/element.py): O(1 tile) on a
+    canonically-held big state, never a full-state relayout — matching
+    the reference's O(1) chunk read (QuEST_cpu_local.c:225-233)."""
+    from .ops import element as E
+
     V.validate_state_vector(qureg, "getAmp")
     V.validate_num_amps(qureg, index, 1, "getAmp")
-    pair = np.asarray(qureg.amps[:, index])
+    pair = np.asarray(E.get_amp_pair(qureg.amps, int(index)))
     return complex(pair[0], pair[1])
 
 
@@ -334,12 +353,15 @@ def getProbAmp(qureg: Qureg, index: int) -> float:
 
 
 def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
-    """Fetch one density-matrix element rho[row, col] (QuEST.h:2072)."""
+    """Fetch one density-matrix element rho[row, col] (QuEST.h:2072) —
+    same layout-safe slice kernel as getAmp."""
+    from .ops import element as E
+
     V.validate_density_matrix(qureg, "getDensityAmp")
     dim = 1 << qureg.num_qubits_represented
     if not (0 <= row < dim and 0 <= col < dim):
         raise V.QuESTError("getDensityAmp: Invalid amplitude index.")
-    pair = np.asarray(qureg.amps[:, row + col * dim])
+    pair = np.asarray(E.get_amp_pair(qureg.amps, int(row + col * dim)))
     return complex(pair[0], pair[1])
 
 

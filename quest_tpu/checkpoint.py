@@ -84,6 +84,9 @@ def loadQureg(path: str, env: QuESTEnv) -> Qureg:
 
 def writeStateToFile(qureg: Qureg, filename: str) -> None:
     """Dump amplitudes as reference-style CSV (QuEST_common.c:229-245)."""
+    from .debug import _guard_host_gather
+
+    _guard_host_gather(qureg, "writeStateToFile")
     amps = np.asarray(qureg.amps)
     with open(filename, "w") as f:
         f.write("# quest_tpu state dump: re, im per amplitude\n")
@@ -94,6 +97,9 @@ def writeStateToFile(qureg: Qureg, filename: str) -> None:
 def readStateFromFile(qureg: Qureg, filename: str) -> bool:
     """Load amplitudes from reference-style CSV; returns success
     (statevec_initStateFromSingleFile, QuEST_cpu.c:1680-1729)."""
+    from .debug import _guard_host_gather
+
+    _guard_host_gather(qureg, "readStateFromFile")
     if not os.path.exists(filename):
         return False
     re = np.zeros(qureg.num_amps_total)

@@ -39,11 +39,27 @@ def initStateFromSingleFile(qureg: Qureg, filename: str,
     return readStateFromFile(qureg, filename)
 
 
+def _guard_host_gather(qureg: Qureg, func: str) -> None:
+    """Refuse to gather a full state to one host buffer beyond the
+    reference's message cap (MPI_MAX_AMPS_IN_MSG — the reference's
+    toQVector guard, utilities.cpp:1073-1074): at 30q+ the gather is also
+    a full-state device relayout (the round-3 OOM trap, BASELINE.md)."""
+    from .precision import max_amps_in_msg
+
+    if qureg.num_amps_total > max_amps_in_msg():
+        raise V.QuESTError(
+            f"{func}: State has too many amplitudes "
+            f"({qureg.num_amps_total} > {max_amps_in_msg()}) to gather to "
+            "a single host buffer; use getAmp/reportState per chunk "
+            "instead.")
+
+
 def compareStates(qureg1: Qureg, qureg2: Qureg, precision: float) -> bool:
     """Amp-wise |re1-re2|, |im1-im2| <= precision on every amplitude
     (statevec_compareStates, QuEST_cpu.c)."""
     if qureg1.num_qubits_in_state_vec != qureg2.num_qubits_in_state_vec:
         return False
+    _guard_host_gather(qureg1, "compareStates")
     a = np.asarray(qureg1.amps)
     b = np.asarray(qureg2.amps)
     return bool(np.all(np.abs(a - b) <= precision))

@@ -1,0 +1,189 @@
+"""Fused measurement: prob -> threshold -> conditional collapse, ONE program.
+
+The reference's measure is a host loop: a full-state probability reduce,
+a host Mersenne-Twister draw, then a collapse sweep
+(statevec_measureWithStats, QuEST_common.c:374-380; the outcome draw
+generateMeasurementOutcome, :168-183) — two dispatches and two
+device->host syncs per shot.  Here the threshold draw happens ON DEVICE
+from a jax.random key (the key is replicated to every shard, preserving
+the reference's same-outcome-on-all-ranks semantics — it broadcasts the
+MT seed instead, QuEST_cpu_distributed.c:1384-1395), the outcome is a
+traced scalar, and the collapse is an elementwise multiply conditioned
+on it: ONE dispatch per shot (measure_fused), or one dispatch for a
+whole measurement sequence (measure_sequence — all 26 qubits of a
+config-2-sized register in a single program).
+
+The host-MT path stays available for reference-seeded stream parity:
+QT_HOST_MEASURE=1 (or QT_STRICT_VALIDATION=1) routes measure through
+the original calcProb -> host RNG -> collapse sequence.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..precision import real_eps
+
+
+def host_path_enabled() -> bool:
+    """Route measure through the host Mersenne-Twister path (the
+    reference's exact sampling stream) instead of the fused device
+    program."""
+    from .. import validation as V
+
+    return os.environ.get("QT_HOST_MEASURE") == "1" or V.strict_parity()
+
+
+class _KeyState:
+    """Global measurement key + shot counter.  Seeded alongside the host
+    MT by seedQuEST (env.seed_quest) so device-side outcome streams are
+    deterministic per seed; the counter is folded into the key per shot,
+    so no per-shot host split (and no recompile — the shot index enters
+    the program as a traced scalar)."""
+
+    __slots__ = ("key", "counter")
+
+    def __init__(self):
+        self.key = None
+        self.counter = 0
+
+    def seed(self, seeds) -> None:
+        key = jax.random.PRNGKey(int(seeds[0]) & 0xFFFFFFFF if seeds else 0)
+        for s in seeds[1:]:
+            key = jax.random.fold_in(key, int(s) & 0xFFFFFFFF)
+        self.key = key
+        self.counter = 0
+
+    def next_shots(self, count: int = 1) -> Tuple[object, int]:
+        """(key, first shot index) reserving ``count`` consecutive shot
+        indices."""
+        if self.key is None:
+            from ..rng import GLOBAL_RNG
+
+            self.seed(GLOBAL_RNG._keys)
+        shot = self.counter
+        self.counter += count
+        return self.key, shot
+
+
+KEYS = _KeyState()
+
+
+def _bit_factor(n: int, pos: int, outcome, dtype):
+    """Indicator of (index bit ``pos`` == TRACED ``outcome``) as a factor
+    broadcastable over the (2, 2^hi, 2^lo) state view, plus the axis it
+    applies to (iota-built, fuses into the consuming multiply like
+    kernels.bit_indicator_2d, whose outcome is static)."""
+    from ..utils import bits as bits_mod
+    from .kernels import _split2
+
+    hi, lo = _split2(n)
+    if pos < lo:
+        i = jax.lax.iota(jnp.int32, 1 << lo)
+        return (bits_mod.bits_of(i, pos) == outcome).astype(dtype)[
+            None, None, :]
+    i = jax.lax.iota(jnp.int32, 1 << hi)
+    return (bits_mod.bits_of(i, pos - lo) == outcome).astype(dtype)[
+        None, :, None]
+
+
+def _collapse_traced_sv(amps, n: int, target: int, outcome, prob):
+    """Zero the discarded half, scale the kept half by 1/sqrt(prob), with
+    a TRACED outcome/prob (statevec_collapseToKnownProbOutcomeLocal,
+    QuEST_cpu.c:3727-3815)."""
+    from .kernels import _split2
+
+    hi, lo = _split2(n)
+    dt = amps.dtype
+    v = amps.reshape(2, 1 << hi, 1 << lo)
+    scale = jax.lax.rsqrt(jnp.asarray(prob, dt))
+    ind = _bit_factor(n, target, outcome, dt)
+    return (v * (ind * scale)).reshape(amps.shape)
+
+
+def _collapse_traced_dm(amps, nq: int, target: int, outcome, prob):
+    """Zero all rho elements whose ket or bra target bit differs from the
+    TRACED outcome, renormalise by 1/prob
+    (densmatr_collapseToKnownProbOutcome, QuEST_cpu.c:785-860)."""
+    from .kernels import _split2
+
+    n = 2 * nq
+    hi, lo = _split2(n)
+    dt = amps.dtype
+    v = amps.reshape(2, 1 << hi, 1 << lo)
+    scale = 1.0 / jnp.asarray(prob, dt)
+    ket = _bit_factor(n, target, outcome, dt)
+    bra = _bit_factor(n, target + nq, outcome, dt)
+    return (v * (ket * scale) * bra).reshape(amps.shape)
+
+
+def _draw_outcome(p0, key, shot, dt):
+    """Traced generateMeasurementOutcome (QuEST_common.c:168-183):
+    degenerate probabilities short-circuit; otherwise threshold a
+    device-drawn uniform against p0 (u <= p0 -> outcome 0, matching the
+    host path's comparison direction)."""
+    eps = real_eps()
+    u = jax.random.uniform(jax.random.fold_in(key, shot), dtype=dt)
+    outcome = jnp.where(
+        p0 < eps, 1,
+        jnp.where(1 - p0 < eps, 0, jnp.where(u <= p0, 0, 1))
+    ).astype(jnp.int32)
+    prob = jnp.where(outcome == 0, p0, 1 - p0).astype(dt)
+    return outcome, prob
+
+
+def _measure_once(amps, key, shot, num_qubits: int, target: int,
+                  is_density: bool):
+    from . import calculations as C
+
+    dt = amps.dtype
+    if is_density:
+        p0 = C.calc_prob_of_outcome_density(
+            amps, num_qubits=num_qubits, target=target, outcome=0)
+    else:
+        p0 = C.calc_prob_of_outcome_statevec(
+            amps, num_qubits=num_qubits, target=target, outcome=0)
+    outcome, prob = _draw_outcome(p0, key, shot, dt)
+    if is_density:
+        amps = _collapse_traced_dm(amps, num_qubits, target, outcome, prob)
+    else:
+        amps = _collapse_traced_sv(amps, num_qubits, target, outcome, prob)
+    return amps, outcome, prob
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "target", "is_density"),
+         donate_argnums=0)
+def measure_fused(amps, key, shot, *, num_qubits: int, target: int,
+                  is_density: bool):
+    """One measurement shot as one compiled program: probability reduce,
+    on-device threshold draw, conditional collapse.  Returns
+    (new_amps, outcome int32, outcome probability).  ``num_qubits`` is
+    the REPRESENTED count (state bits = 2x for a density matrix)."""
+    return _measure_once(amps, key, shot, num_qubits, target, is_density)
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "targets", "is_density"),
+         donate_argnums=0)
+def measure_sequence(amps, key, shot, *, num_qubits: int,
+                     targets: Tuple[int, ...], is_density: bool):
+    """Measure a SEQUENCE of qubits in one compiled program — each step
+    collapses before the next qubit's probability is computed, exactly as
+    a loop of measure() calls would, but with a single dispatch for the
+    whole sequence (the reference has no analogue; its measure is
+    irreducibly one host round-trip per qubit).  Shot indices
+    shot..shot+len(targets)-1 are consumed, so outcome streams match a
+    loop of measure_fused calls."""
+    outs, probs = [], []
+    for j, t in enumerate(targets):
+        amps, o, p = _measure_once(amps, key, shot + j, num_qubits, t,
+                                   is_density)
+        outs.append(o)
+        probs.append(p)
+    return amps, jnp.stack(outs), jnp.stack(probs)

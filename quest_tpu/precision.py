@@ -63,3 +63,16 @@ def complex_dtype():
 def real_eps() -> float:
     """Validation tolerance, matching QuEST_precision.h REAL_EPS."""
     return _REAL_EPS[_state.quest_prec]
+
+
+# Reference cap on amps per MPI message / full-state host gather
+# (MPI_MAX_AMPS_IN_MSG, QuEST_precision.h:32,46,61: ~2 GB per message —
+# 2^29 amps single, 2^28 double).  quest_tpu applies it where a whole
+# state would be gathered to one host buffer (compareStates, CSV
+# loaders, reportStateToScreen — the reference guards its toQVector the
+# same way, utilities.cpp:1073-1074).
+_MAX_AMPS_IN_MSG = {1: 1 << 29, 2: 1 << 28}
+
+
+def max_amps_in_msg() -> int:
+    return _MAX_AMPS_IN_MSG[_state.quest_prec]

@@ -1,0 +1,111 @@
+"""Shot-loop benchmark at 26q (VERDICT r3 item 2 'done' criterion):
+host-MT measure (2 dispatches + 2 syncs/shot) vs the fused one-dispatch
+program vs the whole-sequence single-dispatch program.
+
+Writes scripts/bench_measure_result.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_measure_result.json")
+
+
+def log(*a):
+    print(f"[{time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    log("claiming device ...")
+    devs = jax.devices()
+    log(f"devices: {devs}")
+
+    import quest_tpu as qt
+    from quest_tpu.ops import measurement as M
+
+    n = 26
+    env = qt.createQuESTEnv()
+    results = {"n": n, "devices": str(devs)}
+
+    def prepare():
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            for t in range(n):
+                qt.hadamard(q, t)
+        q.amps.block_until_ready()
+        return q
+
+    qt.seedQuEST(env, [1])
+
+    # -- host-MT path: calcProb dispatch + host draw + collapse dispatch
+    os.environ["QT_HOST_MEASURE"] = "1"
+    # warm EVERY per-target jit signature so the loop timing is pure
+    # dispatch (prob + collapse jits are keyed on the static target)
+    q = prepare()
+    outs = [qt.measure(q, t) for t in range(n)]
+    q = prepare()
+    t0 = time.time()
+    host_outs = [qt.measure(q, t) for t in range(n)]
+    q.amps.block_until_ready()
+    host_s = time.time() - t0
+    results["host_loop_s"] = host_s
+    results["host_per_shot_ms"] = 1e3 * host_s / n
+    log(f"host path: {host_s:.3f} s ({1e3 * host_s / n:.1f} ms/shot)")
+    del os.environ["QT_HOST_MEASURE"]
+
+    # -- fused per-shot path (one dispatch per shot)
+    q = prepare()
+    for t in (0, 1):
+        qt.measure(q, t)  # warm two target signatures
+    # warm ALL target signatures so the loop timing is dispatch, not compile
+    q = prepare()
+    for t in range(n):
+        qt.measure(q, t)
+    q = prepare()
+    t0 = time.time()
+    fused_outs = [qt.measure(q, t) for t in range(n)]
+    q.amps.block_until_ready()
+    fused_s = time.time() - t0
+    results["fused_loop_s"] = fused_s
+    results["fused_per_shot_ms"] = 1e3 * fused_s / n
+    log(f"fused per-shot: {fused_s:.3f} s ({1e3 * fused_s / n:.1f} ms/shot)")
+
+    # -- sequence program: ONE dispatch for all 26 shots
+    q = prepare()
+    key, shot = M.KEYS.next_shots(n)
+    amps, outs, probs = M.measure_sequence(
+        q.amps, key, shot, num_qubits=n, targets=tuple(range(n)),
+        is_density=False)
+    outs.block_until_ready()  # compiled
+    q = prepare()
+    key, shot = M.KEYS.next_shots(n)
+    t0 = time.time()
+    amps, outs, probs = M.measure_sequence(
+        q.amps, key, shot, num_qubits=n, targets=tuple(range(n)),
+        is_density=False)
+    outs.block_until_ready()
+    seq_s = time.time() - t0
+    results["sequence_s"] = seq_s
+    results["sequence_per_shot_ms"] = 1e3 * seq_s / n
+    results["speedup_fused_vs_host"] = host_s / fused_s
+    results["speedup_sequence_vs_host"] = host_s / seq_s
+    log(f"sequence: {seq_s:.3f} s ({1e3 * seq_s / n:.2f} ms/shot)")
+    log(f"speedups vs host: fused {host_s / fused_s:.1f}x, "
+        f"sequence {host_s / seq_s:.1f}x")
+
+    with open(RESULT, "w") as f:
+        json.dump(results, f, indent=2)
+    log(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Layout-safe element access (ops/element.py): canonical-view reads and
+ranged writes match the flat reference behavior, the public API routes
+through them, and the full-state host-gather guard trips at the
+reference's message cap (MPI_MAX_AMPS_IN_MSG, QuEST_precision.h:32-61;
+toQVector guard utilities.cpp:1073-1074)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import precision
+from quest_tpu.ops import element as E
+import oracle
+
+import jax.numpy as jnp
+
+
+def _canonical(flat):
+    n = int(np.log2(flat.shape[1]))
+    return jnp.asarray(flat).reshape(2, 1 << (n - 14), 128, 128)
+
+
+@pytest.mark.parametrize("index", [0, 1, 127, 128, (1 << 14) - 1, 1 << 14,
+                                   (1 << 15) + 12345, (1 << 16) - 1])
+def test_get_amp_pair_canonical_matches_flat(index):
+    n = 16
+    rng = np.random.default_rng(3)
+    flat = rng.standard_normal((2, 1 << n))
+    can = _canonical(flat)
+    got = np.asarray(E.get_amp_pair(can, index))
+    np.testing.assert_allclose(got, flat[:, index], rtol=1e-12)
+    got_flat = np.asarray(E.get_amp_pair(jnp.asarray(flat), index))
+    np.testing.assert_allclose(got_flat, flat[:, index], rtol=1e-12)
+
+
+@pytest.mark.parametrize("start,m", [
+    (0, 5),                       # head of first block
+    (100, 1 << 14),               # spans two blocks, both partial
+    (1 << 14, 1 << 14),           # exactly one full block
+    (5, 3 * (1 << 14)),           # partial + 2 full + partial
+    ((1 << 16) - 7, 7),           # tail of last block
+])
+def test_set_amp_range_canonical_matches_flat(start, m):
+    n = 16
+    rng = np.random.default_rng(4)
+    flat = rng.standard_normal((2, 1 << n))
+    vals = rng.standard_normal((2, m))
+    expect = flat.copy()
+    expect[:, start:start + m] = vals
+    got = np.asarray(
+        E.set_amp_range(_canonical(flat), start, vals)).reshape(2, -1)
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+
+def test_api_get_set_roundtrip(env):
+    rng = np.random.default_rng(5)
+    q = qt.createQureg(5, env)
+    vec = oracle.random_state(5, rng)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    for i in (0, 7, 31):
+        a = qt.getAmp(q, i)
+        assert abs(a - vec[i]) < 1e-12
+        assert abs(qt.getProbAmp(q, i) - abs(vec[i]) ** 2) < 1e-12
+    qt.setAmps(q, 3, [0.5, 0.25], [0.1, -0.1], 2)
+    assert abs(qt.getAmp(q, 3) - (0.5 + 0.1j)) < 1e-12
+    assert abs(qt.getAmp(q, 4) - (0.25 - 0.1j)) < 1e-12
+    assert abs(qt.getAmp(q, 5) - vec[5]) < 1e-12
+
+
+def test_get_density_amp(env):
+    rng = np.random.default_rng(6)
+    r = qt.createDensityQureg(4, env)
+    mat = oracle.random_density(4, rng)
+    oracle.set_qureg_from_array(qt, r, mat)
+    for row, col in ((0, 0), (3, 9), (15, 15)):
+        assert abs(qt.getDensityAmp(r, row, col) - mat[row, col]) < 1e-12
+
+
+def test_host_gather_guard_trips(env, monkeypatch):
+    monkeypatch.setitem(precision._MAX_AMPS_IN_MSG,
+                        precision.get_precision(), 16)
+    q1 = qt.createQureg(5, env)
+    q2 = qt.createQureg(5, env)
+    from quest_tpu import debug
+    with pytest.raises(qt.QuESTError, match="too many amplitudes"):
+        debug.compareStates(q1, q2, 1e-10)
+    from quest_tpu import checkpoint
+    with pytest.raises(qt.QuESTError, match="too many amplitudes"):
+        checkpoint.writeStateToFile(q1, "/tmp/qt_guard_test.csv")
+    with pytest.raises(qt.QuESTError, match="too many amplitudes"):
+        qt.reportStateToScreen(q1)
+
+
+def test_guard_not_tripped_at_normal_sizes(env):
+    q1 = qt.createQureg(5, env)
+    q2 = qt.createQureg(5, env)
+    from quest_tpu import debug
+    assert debug.compareStates(q1, q2, 1e-10)

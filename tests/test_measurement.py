@@ -1,0 +1,161 @@
+"""Fused device-side measurement (ops/measurement.py): one compiled
+prob -> threshold -> collapse program per shot, key-seeded determinism,
+statistical correctness (chi^2), stream equality between the sequence
+program and a loop of single shots, and the host-MT parity path.
+
+Reference semantics: statevec_measureWithStats
+(QuEST_common.c:374-380), generateMeasurementOutcome (:168-183),
+densmatr_collapseToKnownProbOutcome (QuEST_cpu.c:785-860)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.ops import measurement as M
+import oracle
+
+NQ = 5
+
+
+def _ry(theta):
+    c, s = np.cos(theta / 2), np.sin(theta / 2)
+    return np.array([[c, -s], [s, c]])
+
+
+def test_seeded_outcomes_deterministic(env):
+    runs = []
+    for _ in range(2):
+        qt.seedQuEST(env, [424242])
+        q = qt.createQureg(NQ, env)
+        for t in range(NQ):
+            qt.hadamard(q, t)
+        runs.append([qt.measure(q, t) for t in range(NQ)])
+    assert runs[0] == runs[1]
+
+
+def test_measure_collapses_statevector(env):
+    qt.seedQuEST(env, [7])
+    rng = np.random.default_rng(5)
+    vec = oracle.random_state(NQ, rng)
+    q = qt.createQureg(NQ, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    outcome, prob = qt.measureWithStats(q, 2)
+    # analytic projection for whichever outcome occurred
+    idx = (np.arange(1 << NQ) >> 2) & 1
+    keep = vec * (idx == outcome)
+    p_ref = float(np.sum(np.abs(keep) ** 2))
+    assert abs(prob - p_ref) < 1e-10
+    np.testing.assert_allclose(oracle.state_from_qureg(q),
+                               keep / np.sqrt(p_ref), atol=1e-10)
+
+
+def test_measure_collapses_density_matrix(env):
+    qt.seedQuEST(env, [8])
+    rng = np.random.default_rng(6)
+    mat = oracle.random_density(NQ, rng)
+    r = qt.createDensityQureg(NQ, env)
+    oracle.set_qureg_from_array(qt, r, mat)
+    outcome, prob = qt.measureWithStats(r, 1)
+    idx = (np.arange(1 << NQ) >> 1) & 1
+    proj = np.diag((idx == outcome).astype(float))
+    ref = proj @ mat @ proj
+    p_ref = float(np.real(np.trace(ref)))
+    assert abs(prob - p_ref) < 1e-10
+    np.testing.assert_allclose(oracle.state_from_qureg(r), ref / p_ref,
+                               atol=1e-10)
+    assert abs(qt.calcTotalProb(r) - 1.0) < 1e-10
+
+
+def test_degenerate_probabilities_short_circuit(env):
+    qt.seedQuEST(env, [9])
+    q = qt.createQureg(NQ, env)  # |00000>
+    assert qt.measure(q, 3) == 0
+    qt.pauliX(q, 3)
+    o, p = qt.measureWithStats(q, 3)
+    assert o == 1 and abs(p - 1.0) < 1e-12
+
+
+def test_sequence_program_matches_single_shot_stream(env):
+    """measure_sequence consumes the same shot indices as a loop of
+    measure() calls, so the outcome streams are identical."""
+    rng = np.random.default_rng(11)
+    vec = oracle.random_state(NQ, rng)
+
+    qt.seedQuEST(env, [31337])
+    q = qt.createQureg(NQ, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    singles = [qt.measure(q, t) for t in range(NQ)]
+    after_singles = oracle.state_from_qureg(q)
+
+    qt.seedQuEST(env, [31337])
+    q2 = qt.createQureg(NQ, env)
+    oracle.set_qureg_from_array(qt, q2, vec)
+    key, shot = M.KEYS.next_shots(NQ)
+    amps, outs, probs = M.measure_sequence(
+        q2.amps, key, shot, num_qubits=NQ, targets=tuple(range(NQ)),
+        is_density=False)
+    q2.amps = amps
+    assert list(np.asarray(outs)) == singles
+    np.testing.assert_allclose(oracle.state_from_qureg(q2), after_singles,
+                               atol=1e-10)
+
+
+def test_chi_square_outcome_distribution(env):
+    """Bernoulli statistics: a product state of qubits rotated to
+    p(0) = cos^2(theta/2) measured via the sequence program.  Each qubit
+    of a product state measures independently, so n_qubits outcomes per
+    preparation are i.i.d. samples.  chi^2 over 2 cells with 600 samples;
+    threshold 10.83 = p < 0.001 (1 dof)."""
+    theta = 1.2
+    p0 = float(np.cos(theta / 2) ** 2)
+    n = 12
+    shots = 50
+    qt.seedQuEST(env, [20260731])
+    counts = [0, 0]
+    u = _ry(theta)
+    for _ in range(shots):
+        q = qt.createQureg(n, env)
+        for t in range(n):
+            qt.unitary(q, t, u)
+        key, shot = M.KEYS.next_shots(n)
+        _, outs, _ = M.measure_sequence(
+            q.amps, key, shot, num_qubits=n, targets=tuple(range(n)),
+            is_density=False)
+        for o in np.asarray(outs):
+            counts[int(o)] += 1
+    total = sum(counts)
+    exp0 = total * p0
+    exp1 = total * (1 - p0)
+    chi2 = (counts[0] - exp0) ** 2 / exp0 + (counts[1] - exp1) ** 2 / exp1
+    assert chi2 < 10.83, (counts, p0)
+
+
+def test_host_mt_parity_path(env, monkeypatch):
+    """QT_HOST_MEASURE=1 routes through the reference's host
+    calcProb -> MT draw -> collapse sequence (strict stream parity)."""
+    monkeypatch.setenv("QT_HOST_MEASURE", "1")
+    qt.seedQuEST(env, [55])
+    from quest_tpu.rng import GLOBAL_RNG
+    # snapshot the MT stream: the host path must consume exactly one draw
+    state_before = GLOBAL_RNG._rng.get_state()[1].copy()
+    q = qt.createQureg(NQ, env)
+    qt.hadamard(q, 0)
+    o = qt.measure(q, 0)
+    assert o in (0, 1)
+    state_after = GLOBAL_RNG._rng.get_state()[1].copy()
+    assert not np.array_equal(state_before, state_after)
+    assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+
+
+def test_collapse_to_outcome_still_exact(env):
+    rng = np.random.default_rng(21)
+    vec = oracle.random_state(NQ, rng)
+    q = qt.createQureg(NQ, env)
+    oracle.set_qureg_from_array(qt, q, vec)
+    p = qt.collapseToOutcome(q, 0, 1)
+    idx = np.arange(1 << NQ) & 1
+    keep = vec * (idx == 1)
+    p_ref = float(np.sum(np.abs(keep) ** 2))
+    assert abs(p - p_ref) < 1e-10
+    np.testing.assert_allclose(oracle.state_from_qureg(q),
+                               keep / np.sqrt(p_ref), atol=1e-10)
