@@ -408,12 +408,11 @@ def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
     V.validate_num_amps(qureg, startInd, numAmps, "setAmps")
     from .ops import element as E
 
-    vals = np.stack(
-        [
-            np.asarray(reals, dtype=np.float64)[:numAmps],
-            np.asarray(imags, dtype=np.float64)[:numAmps],
-        ]
-    ).astype(qureg.dtype)
+    re = np.asarray(reals, dtype=np.float64).ravel()[:numAmps]
+    im = np.asarray(imags, dtype=np.float64).ravel()[:numAmps]
+    if re.size != numAmps or im.size != numAmps:
+        raise V.QuESTError("setAmps: Incorrect number of amplitudes.")
+    vals = np.stack([re, im]).astype(qureg.dtype)
     # layout-safe ranged write: tile-aligned block updates + edge tiles,
     # never the eager .at[].set() whose gather relayouts a canonically-
     # held big state (ops/element.py)

@@ -181,10 +181,28 @@ def _mix_kraus(qureg: Qureg, ops, targets) -> None:
     """Apply a Kraus channel: under gateFusion the superoperator is
     CAPTURED into the drain as a dense gate on (T, T+n) — noise channels
     then fold into the same window passes as gates (one compiled program
-    for a whole noise layer) — otherwise the generic superoperator kernel
-    runs eagerly (QuEST_common.c:630-652)."""
+    for a whole noise layer); on a sharded register with sharded bra
+    bits the superoperator routes through the dense-gate dispatcher
+    (SWAP-relocalization, 2 ppermutes per sharded bit — the reference's
+    distributed multiQubitUnitary strategy the Kraus fold rides,
+    QuEST_common.c:630-652 + QuEST_cpu_distributed.c:1503-1545);
+    otherwise the generic superoperator kernel runs eagerly."""
     if _capture_channel(qureg, ops, targets):
         return
+    if _explicit_sharded(qureg):
+        from .api import _dispatch_matrix
+        from .ops import cplx as CX
+        from .parallel import dist as PAR
+
+        nq = qureg.num_qubits_represented
+        nloc = 2 * nq - PAR.num_shard_bits(qureg.env.mesh)
+        sv_targets = D.kraus_targets(tuple(targets), nq)
+        if any(t >= nloc for t in sv_targets):
+            sup = D.superoperator_from_kraus(ops)
+            dt = np.float64 if qureg.amps.dtype == jnp.float64 else np.float32
+            qureg.amps = _dispatch_matrix(
+                qureg, CX.soa(sup).astype(dt), tuple(sv_targets), (), ())
+            return
     qureg.amps = D.apply_kraus_map(
         qureg.amps, ops, num_qubits=qureg.num_qubits_represented, targets=tuple(targets)
     )
@@ -263,13 +281,33 @@ def mixDamping(qureg: Qureg, targetQubit: int, prob: float) -> None:
 
 
 def mixTwoQubitDepolarising(qureg: Qureg, qubit1: int, qubit2: int, prob: float) -> None:
-    """Two-qubit depolarising channel (QuEST.h:3601)."""
+    """Two-qubit depolarising channel (QuEST.h:3601).  Routed, in order:
+    fusion capture (superoperator folds into the drain) -> explicit
+    <=2-ppermute double-flip orbit kernel for sharded bra bits
+    (dist.mix_two_qubit_depol_sharded, the reference's dedicated
+    distributed algorithm QuEST_cpu_distributed.c:553-852) -> the
+    dedicated elementwise orbit kernel (never the 256x generic
+    superoperator, ref QuEST_cpu.c:387-733)."""
     V.validate_density_matrix(qureg, "mixTwoQubitDepolarising")
     V.validate_unique_targets(qureg, qubit1, qubit2, "mixTwoQubitDepolarising")
     V.validate_two_qubit_depol_prob(prob, "mixTwoQubitDepolarising")
-    _mix_kraus(
-        qureg, D.two_qubit_depolarising_kraus(prob, qureg.dtype), (qubit1, qubit2)
-    )
+    if _capture_channel(
+            qureg, D.two_qubit_depolarising_kraus(prob, qureg.dtype),
+            (qubit1, qubit2)):
+        return
+    if _explicit_sharded(qureg):
+        from .parallel import dist as PAR
+
+        nq = qureg.num_qubits_represented
+        nloc = 2 * nq - PAR.num_shard_bits(qureg.env.mesh)
+        if max(qubit1, qubit2) + nq >= nloc:
+            qureg.amps = PAR.mix_two_qubit_depol_sharded(
+                qureg.amps, prob, mesh=qureg.env.mesh, num_qubits=nq,
+                qubit1=qubit1, qubit2=qubit2)
+            return
+    qureg.amps = D.mix_two_qubit_depolarising(
+        qureg.amps, prob, num_qubits=qureg.num_qubits_represented,
+        qubit1=qubit1, qubit2=qubit2)
 
 
 def mixPauli(qureg: Qureg, targetQubit: int, probX: float, probY: float, probZ: float) -> None:
@@ -724,9 +762,23 @@ def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
     (QuEST.c apply-family semantics; densmatr path QuEST_cpu.c:4042-4082)."""
     V.validate_diag_op_matches_qureg(op, qureg, "applyDiagonalOp")
     if qureg.is_density_matrix:
-        qureg.amps = D.apply_diagonal_op_density(
-            qureg.amps, op.real, op.imag, num_qubits=qureg.num_qubits_represented
-        )
+        nq = qureg.num_qubits_represented
+        routed = False
+        if _explicit_sharded(qureg):
+            from .parallel import dist as PAR
+
+            r = PAR.num_shard_bits(qureg.env.mesh)
+            # op must itself be sharded over the amp axis (tiny
+            # replicated ops have nothing to gather) and rows shard-local
+            if (1 << nq) >= PAR.amp_axis_size(qureg.env.mesh) and r <= nq:
+                qureg.amps = PAR.apply_diag_op_density_sharded(
+                    qureg.amps, op.real, op.imag, mesh=qureg.env.mesh,
+                    num_qubits=nq)
+                routed = True
+        if not routed:
+            qureg.amps = D.apply_diagonal_op_density(
+                qureg.amps, op.real, op.imag, num_qubits=nq
+            )
     else:
         qureg.amps = K.apply_full_diagonal(qureg.amps, op.real, op.imag)
     qureg.qasm_log.comment("here a diagonal operator was applied")

@@ -25,9 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-BLK_BITS = 14
-BLK = 1 << BLK_BITS
-DIM = 128
+from .fused import CLUSTER_DIM as DIM, CLUSTER_QUBITS as BLK_BITS
+
+BLK = 1 << BLK_BITS  # amps per canonical block (one 128x128 tile pair)
 
 
 @jax.jit

@@ -1234,9 +1234,20 @@ def apply_pair_channel_sweep(amps, program: tuple, probs, *, num_bits: int,
     nn = num_bits
     if nn < CLUSTER_QUBITS + 1:
         raise ValueError("apply_pair_channel_sweep needs num_bits >= 15")
+    pair_of = {}
     for kind, t, b in program:
         if t >= CLUSTER_QUBITS or b >= nn:
             raise ValueError("sweep channels need ket bit < 14")
+        # HARD PRECONDITION: chunk assignment must be a function of the
+        # bra bit alone — channels sharing a bra bit must share the ket
+        # bit, else call order across non-commuting chunks could be
+        # silently rearranged (relevant if a future kind carries per-call
+        # differing bit pairs, e.g. two-qubit channels)
+        if pair_of.setdefault(b, t) != t:
+            raise ValueError(
+                "apply_pair_channel_sweep: channels sharing a bra bit "
+                "must share the ket bit (chunking is keyed on the bra "
+                "bit; mixed pairs would reorder non-commuting channels)")
     dt = amps.dtype
     wmat = jnp.stack([channel_weights(kind, p, dt)
                       for (kind, _, _), p in zip(program, probs)])

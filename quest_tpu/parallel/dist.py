@@ -444,6 +444,105 @@ def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
     )(amps, codes_seq, coeffs)
 
 
+@partial(jax.jit,
+         static_argnames=("mesh", "num_qubits", "qubit1", "qubit2"),
+         donate_argnums=0)
+def mix_two_qubit_depol_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
+                                qubit1: int, qubit2: int):
+    """Explicit distributed two-qubit depolarising: the double-flip orbit
+    sum S = (1 + F2)(1 + F1) rho computed with AT MOST 2 collectives
+    (one ppermute per flip whose bra bit is a mesh-coordinate bit — the
+    recursive-doubling trick makes the 4-partner sum cost 2 exchanges,
+    where the reference's distributed algorithm is a 3-part
+    pack-and-exchange, QuEST_cpu_distributed.c:553-852), then one fused
+    elementwise combine (see ops/density.mix_two_qubit_depolarising for
+    the block formula)."""
+    nq = num_qubits
+    nn = 2 * nq
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = nn - r
+    dt = amps.dtype
+    t1, b1 = qubit1, qubit1 + nq
+    t2, b2 = qubit2, qubit2 + nq
+    from ..ops import kernels as K
+
+    hi, lo = K._split2(nloc)
+
+    def kernel(local, p):
+        idx = lax.axis_index(AMP_AXIS)
+
+        def dflip(x, t, b):
+            # flip ket bit t AND bra bit b (t < b always: t < nq <= b)
+            if b < nloc:
+                return K._flip_bits_flat(
+                    x.reshape(2, -1), nloc, (t, b)).reshape(x.shape)
+            if t < nloc:
+                perm = _hypercube_perm(ndev, b - nloc)
+                recv = lax.ppermute(x, AMP_AXIS, perm)
+                return K._flip_bits_flat(
+                    recv.reshape(2, -1), nloc, (t,)).reshape(x.shape)
+            perm = [(i, i ^ (1 << (t - nloc)) ^ (1 << (b - nloc)))
+                    for i in range(ndev)]
+            return lax.ppermute(x, AMP_AXIS, perm)
+
+        s = local + dflip(local, t1, b1)
+        s = s + dflip(s, t2, b2)
+
+        def bitval(pos):
+            if pos < nloc:
+                return K.bit_2d(nloc, pos).astype(dt)
+            return ((idx >> (pos - nloc)) & 1).astype(dt)
+
+        def same(t, b):
+            d = bitval(t) - bitval(b)
+            return 1 - d * d
+
+        block = same(t1, b1) * same(t2, b2)     # scalar/2-d broadcast mix
+        c1 = 1 - 16 * p / 15
+        c2 = 4 * p / 15
+        v = local.reshape(2, 1 << hi, 1 << lo)
+        sv = s.reshape(2, 1 << hi, 1 << lo)
+        out = v * c1 + sv * jnp.broadcast_to(
+            c2 * block, (1 << hi, 1 << lo))[None]
+        return out.reshape(local.shape)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
+        out_specs=P(None, AMP_AXIS),
+    )(amps, jnp.asarray(prob, dt))
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits"), donate_argnums=0)
+def apply_diag_op_density_sharded(amps, op_re, op_im, *, mesh: Mesh,
+                                  num_qubits: int):
+    """applyDiagonalOp on a SHARDED rho: D.rho scales element (row, col)
+    by D[row]; rows live in the LOW n index bits, so every shard needs
+    the whole operator — replicate the (small) op with exactly TWO
+    explicit all_gathers (re, im), never touching the state's sharding:
+    the reference's copyDiagOpIntoMatrixPairState ring-of-broadcasts
+    (QuEST_cpu_distributed.c:1548-1587)."""
+    nq = num_qubits
+    nn = 2 * nq
+    r = num_shard_bits(mesh)
+    nloc = nn - r
+    assert nloc >= nq, "op rows must be shard-local (r <= num_qubits)"
+    dt = amps.dtype
+
+    def kernel(local, re, im):
+        re_full = lax.all_gather(re, AMP_AXIS, axis=0, tiled=True)
+        im_full = lax.all_gather(im, AMP_AXIS, axis=0, tiled=True)
+        v = local.reshape(2, 1 << (nloc - nq), 1 << nq)
+        out = cplx.cmul(v, re_full.astype(dt)[None], im_full.astype(dt)[None])
+        return out.reshape(local.shape)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, AMP_AXIS), P(AMP_AXIS), P(AMP_AXIS)),
+        out_specs=P(None, AMP_AXIS), check_vma=False,
+    )(amps, op_re, op_im)
+
+
 def _ladder_phase_chunks(nbits: int, t_eff: int, sgn: float, dt):
     """Host tables factorizing exp(sgn*i*pi*li / 2^t_eff) over 7-bit chunks
     of the ``nbits``-bit index li (an exponential of a sum of per-bit

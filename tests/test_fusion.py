@@ -394,3 +394,48 @@ class TestChannelCapture:
         prog(eager)
         np.testing.assert_allclose(np.asarray(fused.amps),
                                    np.asarray(eager.amps), atol=1e-5)
+
+
+def test_sharded_drain_channel_sweep(monkeypatch):
+    """ADVICE r3 (a): the chansweep branch INSIDE the sharded drain's
+    shard_map actually runs (needs nloc >= 15: a 9q rho over 8 devices
+    gives nloc = 15) and matches the eager per-channel path.  f32 +
+    QT_CHAN_SWEEP_INTERPRET=1 so channel_sweep_enabled engages on the
+    CPU interpret path."""
+    env = qt.createQuESTEnv()   # the full 8-device mesh, not the pinned
+    if env.num_devices < 8:      # single-device fixture this module uses
+        pytest.skip("needs the 8-device virtual mesh")
+    monkeypatch.setenv("QT_CHAN_SWEEP_INTERPRET", "1")
+    from quest_tpu.ops import fused as F
+    calls = {"n": 0}
+    real_sweep = F.apply_pair_channel_sweep
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real_sweep(*a, **k)
+
+    monkeypatch.setattr(F, "apply_pair_channel_sweep", spy)
+    qt.set_precision(1)
+    try:
+        nq = 9
+        r1 = qt.createDensityQureg(nq, env)
+        qt.initPlusState(r1)
+        r2 = qt.createDensityQureg(nq, env)
+        qt.initPlusState(r2)
+
+        def noise(r):
+            for t in range(6):   # bra bit t+9 < nloc=15 so channels capture
+                qt.mixDepolarising(r, t, 0.03 + 0.01 * t)
+            qt.hadamard(r, 0)
+            for t in range(6):
+                qt.mixDamping(r, t, 0.02)
+
+        with qt.gateFusion(r1):
+            noise(r1)
+        noise(r2)
+        assert calls["n"] >= 1, "chansweep branch never ran in the drain"
+        np.testing.assert_allclose(np.asarray(r1.amps), np.asarray(r2.amps),
+                                   atol=5e-6)
+        assert abs(qt.calcTotalProb(r1) - 1.0) < 1e-5
+    finally:
+        qt.set_precision(2)
