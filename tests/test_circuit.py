@@ -560,3 +560,51 @@ class TestPallasQFTLadder:
         ref = np.asarray(kernels.apply_qft_ladder(
             jnp.asarray(st), num_qubits=n, target=t))
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_split_plan_sides_merges_adjacent_duals():
+    """VERDICT r3 item 6: two adjacent rank-1 maskless dual-side passes
+    rewrite to two B-only passes + ONE merged A pass (the A sides act on
+    lanes [0,7), the B sides on windows >= 7 — disjoint, commuting), and
+    the rewritten plan is numerically identical."""
+    import jax.numpy as jnp
+
+    from quest_tpu import circuit as C
+    from quest_tpu.ops import kernels
+
+    n = 16
+    rng = np.random.default_rng(9)
+
+    def ru():
+        a = rng.standard_normal((128, 128)) + 1j * rng.standard_normal(
+            (128, 128))
+        q, r = np.linalg.qr(a)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag])
+
+    ops = [("winfused", 7, ru()[None], ru()[None], True, True, None),
+           ("winfused", 9, ru()[None], ru()[None], True, True, None)]
+    split = C.split_plan_sides(ops)
+    kinds = [(op[4], op[5]) for op in split]
+    assert kinds == [(False, True), (False, True), (True, False)], kinds
+    a = np.array(kernels.init_debug_state(1 << n, np.float64))
+    a /= np.sqrt((a ** 2).sum())
+    r1 = np.asarray(C.execute_plan(jnp.asarray(a), ops, n))
+    r2 = np.asarray(C.execute_plan(jnp.asarray(a), split, n))
+    np.testing.assert_allclose(r1, r2, atol=1e-11)
+
+
+def test_split_plan_sides_leaves_singletons_and_masked():
+    """A lone dual pass must NOT split (2 x 1.25 ms > 2.1 ms), and
+    mask/rank-tied passes are barriers — exactly why the rewrite never
+    engages on the 26q headline plan (see BASELINE.md round-4 profile)."""
+    from quest_tpu import circuit as C
+
+    rng = np.random.default_rng(10)
+    m = rng.standard_normal((2, 128, 128))
+    single = [("winfused", 7, m[None], m[None], True, True, None)]
+    assert C.split_plan_sides(single) == single
+    masked = [("winfused", 7, m[None], m[None], True, True, m),
+              ("winfused", 9, m[None], m[None], True, True, None),
+              ("winfused", 10, m[None], m[None], True, True, m)]
+    assert C.split_plan_sides(masked) == masked
