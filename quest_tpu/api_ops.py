@@ -404,11 +404,21 @@ def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
 
 
 def calcTotalProb(qureg: Qureg) -> float:
-    """Total probability (trace / norm^2) of the register, Kahan-summed (QuEST.h:2099)."""
+    """Total probability (trace / norm^2) of the register, Kahan-summed
+    (QuEST.h:2099).  Quad precision (set_precision(4)) accumulates in
+    double-double (C.quad_sum — the QuEST_PREC=4 scope decision,
+    precision.set_precision docstring)."""
+    from .precision import get_precision
+
     if qureg.is_density_matrix:
+        if get_precision() == 4:
+            return float(C.calc_total_prob_density_quad(
+                qureg.amps, num_qubits=qureg.num_qubits_represented))
         return float(
             C.calc_total_prob_density(qureg.amps, num_qubits=qureg.num_qubits_represented)
         )
+    if get_precision() == 4:
+        return float(C.calc_total_prob_statevec_quad(qureg.amps))
     return float(C.calc_total_prob_statevec(qureg.amps))
 
 
@@ -417,7 +427,12 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     V.validate_state_vector(bra, "calcInnerProduct")
     V.validate_state_vector(ket, "calcInnerProduct")
     V.validate_matching_qureg_dims(bra, ket, "calcInnerProduct")
-    r = np.asarray(C.calc_inner_product(bra.amps, ket.amps))
+    from .precision import get_precision
+
+    if get_precision() == 4:
+        r = np.asarray(C.calc_inner_product_quad(bra.amps, ket.amps))
+    else:
+        r = np.asarray(C.calc_inner_product(bra.amps, ket.amps))
     return complex(r[0], r[1])
 
 

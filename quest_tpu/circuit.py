@@ -743,18 +743,21 @@ def split_plan_sides(ops: Sequence[tuple]) -> List[tuple]:
 
     def lane_bits_of(a) -> set:
         """Lane bits a (2,128,128) concrete A-operator acts on
-        non-trivially: bit l untouched iff A is block-identity over l,
-        i.e. A[i, j] == 0 whenever i and j differ in bit l and
-        A[i, j] == A[i^e_l, j^e_l]."""
-        u = np.abs(a[0] + 1j * a[1])
+        non-trivially: bit l is untouched iff A factors as I_l (x) A',
+        i.e. BOTH off-blocks over l vanish (every A[i, j] with bit l of
+        i and j differing — not just the single-flip diagonal, which
+        misses multi-bit operators like X_l X_m) AND the two same-bit
+        blocks are equal."""
+        u = a[0] + 1j * a[1]
         idx = np.arange(DIM)
         out = set()
         for l in range(LANE):
-            f = idx ^ (1 << l)
-            cross = u[np.ix_(idx, f)]  # entries flipping bit l once
-            same = np.abs((a[0] + 1j * a[1])
-                          - (a[0] + 1j * a[1])[np.ix_(f, f)]).max()
-            if np.abs(np.diagonal(cross)).max() > 1e-12 or same > 1e-12:
+            r0 = idx[((idx >> l) & 1) == 0]
+            r1 = r0 ^ (1 << l)
+            off = max(np.abs(u[np.ix_(r0, r1)]).max(),
+                      np.abs(u[np.ix_(r1, r0)]).max())
+            sym = np.abs(u[np.ix_(r0, r0)] - u[np.ix_(r1, r1)]).max()
+            if off > 1e-12 or sym > 1e-12:
                 out.add(l)
         return out
 

@@ -34,6 +34,64 @@ def calc_total_prob_statevec(amps):
     return jnp.sum(cplx.abs2(amps))
 
 
+# ---------------------------------------------------------------------------
+# Quad-precision (QuEST_PREC=4) reductions: double-double accumulation
+# ---------------------------------------------------------------------------
+
+_QUAD_BLOCK = 256
+
+
+def quad_sum(x):
+    """Double-double-compensated sum of a vector — the quad-precision
+    (QuEST_PREC=4, QuEST_precision.h:55-68) accumulation mode for the
+    reductions where extended precision is observable.  Pairwise block
+    partials (XLA tree reduce, error eps*log B within a block) are
+    combined with a Neumaier error-free-transform scan, so cross-block
+    cancellation and magnitude disparity accumulate at double-double
+    precision instead of f64."""
+    flat = x.reshape(-1)
+    nb = max(1, flat.size // _QUAD_BLOCK)
+    partials = flat.reshape(nb, -1).sum(axis=1)
+    # cap the serial compensated scan at _QUAD_BLOCK steps: a second
+    # pairwise level costs only eps*log(B) within each super-block while
+    # keeping the scan O(256) instead of O(size/256) (a 26q state would
+    # otherwise be a 262k-step scalar chain)
+    if nb > _QUAD_BLOCK:
+        partials = partials.reshape(_QUAD_BLOCK, -1).sum(axis=1)
+
+    def body(carry, v):
+        s, c = carry
+        t = s + v
+        c = c + jnp.where(jnp.abs(s) >= jnp.abs(v),
+                          (s - t) + v, (v - t) + s)
+        return (t, c), None
+
+    z = jnp.zeros((), flat.dtype)
+    (s, c), _ = jax.lax.scan(body, (z, z), partials)
+    return s + c
+
+
+@jax.jit
+def calc_total_prob_statevec_quad(amps):
+    return quad_sum(cplx.abs2(amps))
+
+
+@partial(jax.jit, static_argnames=("num_qubits",))
+def calc_total_prob_density_quad(amps, *, num_qubits: int):
+    return quad_sum(_diag(amps, num_qubits)[0])
+
+
+@jax.jit
+def calc_inner_product_quad(bra_amps, ket_amps):
+    """<bra|ket> with double-double accumulation (signed terms — the
+    case where cross-block cancellation actually bites)."""
+    br, bi = bra_amps[0], bra_amps[1]
+    kr, ki = ket_amps[0], ket_amps[1]
+    re = quad_sum(br * kr) + quad_sum(bi * ki)
+    im = quad_sum(br * ki) - quad_sum(bi * kr)
+    return jnp.stack([re, im])
+
+
 def _diag(amps, num_qubits: int):
     """Diagonal of the column-major flattened rho: (2, dim) stacked."""
     dim = 1 << num_qubits

@@ -608,3 +608,44 @@ def test_split_plan_sides_leaves_singletons_and_masked():
               ("winfused", 9, m[None], m[None], True, True, None),
               ("winfused", 10, m[None], m[None], True, True, m)]
     assert C.split_plan_sides(masked) == masked
+
+
+def test_split_plan_sides_multibit_lane_product_blocks_mask():
+    """Review regression: an A-side product of X(l).X(m) touches BOTH
+    lane bits (the single-flip-diagonal test missed it); a masked pass
+    depending on either bit must stay a barrier, so the rewrite leaves
+    the plan alone rather than reordering A past a non-commuting mask."""
+    import jax.numpy as jnp
+
+    from quest_tpu import circuit as C
+    from quest_tpu.ops import kernels
+
+    n = 16
+    x = np.array([[0.0, 1.0], [1.0, 0.0]])
+    xx = np.kron(np.eye(1 << 5), np.kron(x, x))  # X on lane bits 0, 1
+    a_xx = np.stack([xx, np.zeros_like(xx)])
+    rng = np.random.default_rng(12)
+
+    def ru():
+        a = rng.standard_normal((128, 128)) + 1j * rng.standard_normal(
+            (128, 128))
+        q, r = np.linalg.qr(a)
+        u = q * (np.diag(r) / np.abs(np.diag(r)))
+        return np.stack([u.real, u.imag])
+
+    # CZ-style diagonal mask depending on lane bit 0
+    lane_phase = np.where((np.arange(128) & 1) == 1, -1.0, 1.0)
+    mask = np.stack([np.broadcast_to(lane_phase, (128, 128)).copy(),
+                     np.zeros((128, 128))])
+    ops = [("winfused", 7, a_xx[None], ru()[None], True, True, None),
+           ("winfused", 9, ru()[None], ru()[None], False, True, mask),
+           ("winfused", 9, ru()[None], ru()[None], True, True, None)]
+    split = C.split_plan_sides(ops)
+    a = np.array(kernels.init_debug_state(1 << n, np.float64))
+    a /= np.sqrt((a ** 2).sum())
+    r1 = np.asarray(C.execute_plan(jnp.asarray(a), ops, n))
+    r2 = np.asarray(C.execute_plan(jnp.asarray(a), split, n))
+    np.testing.assert_allclose(r1, r2, atol=1e-11)
+    # and the masked pass must have stayed a barrier (no merged A pass
+    # crossing it): the first op must still be dual-side
+    assert split[0][4] and split[0][5]

@@ -1,0 +1,73 @@
+"""Quad precision (QuEST_PREC=4, QuEST_precision.h:55-68): the recorded
+scope decision (f64 storage — mirroring the reference's own GPU-quad
+prohibition, QuEST/CMakeLists.txt:69-73 — with double-double-compensated
+reductions where extended precision is observable) plus the REAL_EPS /
+message-cap table extension."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import precision
+from quest_tpu.ops import calculations as C
+
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def quad():
+    qt.set_precision(4)
+    yield
+    qt.set_precision(2)
+
+
+def test_precision_table_extended(quad):
+    assert precision.get_precision() == 4
+    assert precision.real_eps() == 1e-14
+    assert precision.max_amps_in_msg() == 1 << 27
+    assert precision.real_dtype() == jnp.float64
+
+
+def test_invalid_precision_rejected():
+    with pytest.raises(ValueError, match="quest_prec"):
+        qt.set_precision(3)
+
+
+def test_quad_sum_survives_cross_block_cancellation():
+    """Per-block-exact partials of wildly varying signed magnitude: the
+    plain pairwise tree loses the small term to rounding at 1e16; the
+    Neumaier double-double combine keeps it."""
+    B = C._QUAD_BLOCK
+    v = np.zeros(4 * B)
+    v[0] = 1e16
+    v[B] = 1.0
+    v[2 * B] = -1e16
+    v[3 * B] = 1e-3
+    got = float(C.quad_sum(jnp.asarray(v)))
+    assert got == pytest.approx(1.0 + 1e-3, abs=1e-12)
+
+
+def test_quad_total_prob_and_inner_product(env, quad):
+    rng = np.random.default_rng(3)
+    n = 6
+    q = qt.createQureg(n, env)
+    vec = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    vec /= np.linalg.norm(vec)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    assert abs(qt.calcTotalProb(q) - 1.0) < 1e-14
+    q2 = qt.createQureg(n, env)
+    vec2 = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    vec2 /= np.linalg.norm(vec2)
+    qt.initStateFromAmps(q2, vec2.real, vec2.imag)
+    ip = qt.calcInnerProduct(q, q2)
+    assert abs(ip - np.vdot(vec, vec2)) < 1e-13
+
+
+def test_quad_register_lifecycle(env, quad):
+    """The full gate path runs at prec 4 (f64 storage, tighter eps)."""
+    q = qt.createQureg(5, env)
+    qt.hadamard(q, 0)
+    for t in range(1, 5):
+        qt.controlledNot(q, t - 1, t)
+    assert abs(qt.calcProbOfOutcome(q, 4, 0) - 0.5) < 1e-14
+    assert q.amps.dtype == jnp.float64
