@@ -96,6 +96,13 @@ def _enable_compilation_cache() -> None:
     if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
             or jax.config.jax_compilation_cache_dir):
         return
+    # CPU AOT cache entries embed the compile host's microarch features
+    # and can SIGILL on a different host (XLA warns on load); the compile
+    # cost being killed is the accelerator programs' anyway — default the
+    # cache on only off-CPU (QT_COMPILE_CACHE_DIR forces it on anywhere)
+    if (jax.default_backend() == "cpu"
+            and "QT_COMPILE_CACHE_DIR" not in os.environ):
+        return
     cache_dir = os.environ.get(
         "QT_COMPILE_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "quest_tpu_xla"))
