@@ -50,7 +50,7 @@ GROUPS = [
                    "multiControlledMultiQubitUnitary"]),
     ("Measurement and collapse", ["calcProbOfOutcome", "calcProbOfAllOutcomes",
                                   "collapseToOutcome", "measure",
-                                  "measureWithStats"]),
+                                  "measureWithStats", "measureSequence"]),
     ("Decoherence", ["mixDephasing", "mixTwoQubitDephasing", "mixDepolarising",
                      "mixDamping", "mixTwoQubitDepolarising", "mixPauli",
                      "mixDensityMatrix", "mixKrausMap", "mixTwoQubitKrausMap",

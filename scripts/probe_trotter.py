@@ -1,0 +1,127 @@
+"""Where does config-5's 0.62 s K-diff go?  On-chip decomposition of the
+Trotter/expec scan at 24q: per-term marginal cost via scans of varying
+length, one product layer alone, one parity phase alone.
+
+Writes scripts/probe_trotter_result.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    print("devices:", devs, flush=True)
+
+    from quest_tpu.ops import kernels
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+
+    def state():
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jnp.asarray(a)
+
+    def kdiff(label, run_k, reps=5):
+        run_k(1)
+        run_k(2)
+        ds = []
+        for _ in range(reps):
+            t1 = run_k(1)
+            t2 = run_k(2)
+            ds.append(t2 - t1)
+        ds.sort()
+        res[label] = {"median": round(ds[len(ds) // 2], 4),
+                      "min": round(min(ds), 4)}
+        print(label, res[label], flush=True)
+
+    # scan of T terms: marginal per-term cost
+    for T in (2, 8, 16):
+        codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+        angles = jnp.asarray(rng.normal(size=T))
+
+        def run_k(k, codes=codes, angles=angles):
+            a = state()
+            t0 = time.perf_counter()
+            for _ in range(k):
+                a = P.trotter_scan(a, codes, angles, num_qubits=n,
+                                   rep_qubits=n)
+            float(jnp.sum(a[0, :1]))
+            return time.perf_counter() - t0
+
+        kdiff(f"trotter_scan_T{T}", run_k)
+
+    # one product layer alone (concrete random 1q mats, window path)
+    from functools import partial
+
+    mats = jnp.asarray(rng.standard_normal((n, 2, 2, 2)).astype(np.float32))
+
+    @partial(jax.jit, static_argnames="k")
+    def layer_prog(a, m, k):
+        for _ in range(k):
+            a = P._product_layer(a, m, n)
+        return a
+
+    def run_layer(k):
+        a = state()
+        t0 = time.perf_counter()
+        a = layer_prog(a, mats, k)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    kdiff("product_layer", run_layer)
+
+    # parity phase alone (traced mask)
+    @partial(jax.jit, static_argnames="k")
+    def phase_prog(a, k):
+        zlo = jnp.uint32(0x00AAAAAA)
+        zhi = jnp.uint32(0)
+        for _ in range(k):
+            a = P._parity_phase_mask(a, jnp.float32(0.3), zlo, zhi, n)
+        return a
+
+    def run_phase(k):
+        a = state()
+        t0 = time.perf_counter()
+        a = phase_prog(a, k)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    kdiff("parity_phase", run_phase)
+
+    # expec scan
+    for T in (4, 16):
+        codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+        coeffs = jnp.asarray(rng.normal(size=T))
+
+        def run_k(k, codes=codes, coeffs=coeffs):
+            a = state()
+            t0 = time.perf_counter()
+            v = 0.0
+            for _ in range(k):
+                v = P.expec_pauli_sum_scan(a, codes, coeffs, num_qubits=n)
+            float(v)
+            return time.perf_counter() - t0
+
+        kdiff(f"expec_scan_T{T}", run_k)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_trotter_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

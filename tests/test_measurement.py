@@ -159,3 +159,43 @@ def test_collapse_to_outcome_still_exact(env):
     assert abs(p - p_ref) < 1e-10
     np.testing.assert_allclose(oracle.state_from_qureg(q),
                                keep / np.sqrt(p_ref), atol=1e-10)
+
+
+def test_measure_sequence_public_api(env):
+    """measureSequence = one-dispatch batched measurement matching the
+    per-call stream, including QASM records and density registers."""
+    qt.seedQuEST(env, [777])
+    q = qt.createQureg(NQ, env)
+    for t in range(NQ):
+        qt.hadamard(q, t)
+    qt.startRecordingQASM(q)
+    outs, probs = qt.measureSequence(q, range(NQ))
+    qt.stopRecordingQASM(q)
+    assert len(outs) == NQ and all(o in (0, 1) for o in outs)
+    assert all(abs(p - 0.5) < 1e-9 for p in probs)
+    assert str(q.qasm_log).count("measure") >= NQ
+    # density register
+    r = qt.createDensityQureg(3, env)
+    qt.initPlusState(r)
+    outs2, probs2 = qt.measureSequence(r, [0, 1, 2])
+    assert len(outs2) == 3
+    assert abs(qt.calcTotalProb(r) - 1.0) < 1e-10
+
+
+def test_measure_sequence_matches_measure_loop(env):
+    qt.seedQuEST(env, [888])
+    q1 = qt.createQureg(4, env)
+    for t in range(4):
+        qt.hadamard(q1, t)
+    loop = [qt.measure(q1, t) for t in range(4)]
+    qt.seedQuEST(env, [888])
+    q2 = qt.createQureg(4, env)
+    for t in range(4):
+        qt.hadamard(q2, t)
+    seq, _ = qt.measureSequence(q2, range(4))
+    assert seq == loop
+
+
+def test_measure_sequence_empty(env):
+    q = qt.createQureg(3, env)
+    assert qt.measureSequence(q, []) == ([], [])
