@@ -60,3 +60,10 @@ def test_phase_estimation(mode):
     assert r.returncode == 0, r.stderr
     assert "estimate" in r.stdout
     assert "|error| = 0.0" in r.stdout
+
+
+def test_shot_sampling():
+    r = _run("shot_sampling.py",
+             env_extra={"QT_SHOT_QUBITS": "6", "QT_SHOT_COUNT": "40"})
+    assert r.returncode == 0, r.stderr
+    assert "top-2 mass" in r.stdout
