@@ -1,0 +1,106 @@
+"""Drift-resistant config-5 decomposition: marginal device time via
+large-K contrast ((T[8x] - T[1x]) / 7, best of reps) instead of the 2x
+K-diff the chip's session drift swamped.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("devices:", jax.devices(), flush=True)
+    from functools import partial
+
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+
+    def state():
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jnp.asarray(a)
+
+    KHI = 8
+
+    def marginal(label, run_k, reps=5):
+        run_k(1)
+        run_k(KHI)
+        ds = []
+        for _ in range(reps):
+            t1 = run_k(1)
+            t8 = run_k(KHI)
+            ds.append((t8 - t1) / (KHI - 1))
+        ds.sort()
+        res[label] = {"median": round(ds[len(ds) // 2], 5),
+                      "min": round(min(ds), 5)}
+        print(label, res[label], flush=True)
+
+    T = 16
+    codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+    angles = jnp.asarray(rng.normal(size=T))
+
+    def run_scan(k):
+        a = state()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = P.trotter_scan(a, codes, angles, num_qubits=n, rep_qubits=n)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("trotter_scan_T16_per_call", run_scan)
+
+    mats = jnp.asarray(rng.standard_normal((n, 2, 2, 2)).astype(np.float32))
+
+    @partial(jax.jit, static_argnames="k")
+    def layer_prog(a, m, k):
+        for _ in range(k):
+            a = P._product_layer(a, m, n)
+        return a
+
+    def run_layer(k):
+        a = state()
+        t0 = time.perf_counter()
+        a = layer_prog(a, mats, k)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("product_layer_per_pass", run_layer)
+
+    @partial(jax.jit, static_argnames="k")
+    def phase_prog(a, k):
+        zlo = jnp.uint32(0x00AAAAAA)
+        zhi = jnp.uint32(0)
+        for _ in range(k):
+            a = P._parity_phase_mask(a, jnp.float32(0.3), zlo, zhi, n)
+        return a
+
+    def run_phase(k):
+        a = state()
+        t0 = time.perf_counter()
+        a = phase_prog(a, k)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("parity_phase_per_pass", run_phase)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_trotter2_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()
