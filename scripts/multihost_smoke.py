@@ -1,0 +1,147 @@
+"""Multi-HOST (multi-process) validation of the distributed backend.
+
+The reference scales across nodes with MPI (QuEST_cpu_distributed.c);
+quest_tpu's analogue is jax.distributed + the shard_map/ppermute kernel
+layer riding whatever links connect the processes (ICI within a slice,
+DCN/TCP across).  This script actually runs TWO OS PROCESSES (gloo
+collectives over TCP — the DCN stand-in), each owning half of an
+8-device mesh, and drives the explicit distributed kernels across the
+process boundary:
+
+  * total_prob_sharded      — psum spanning both processes
+  * apply_matrix_1q_sharded — ppermute exchange on the top (cross-
+                              process) qubit; H twice restores the state
+  * fused_qft_sharded       — QFT|0..0> = uniform state: every local
+                              shard must read 2^(-n/2) everywhere
+  * trotter_scan_sharded    — a term stream then its exact inverse
+                              restores the state
+  * expec_pauli_sum_scan_sharded — known <Z-string> values on |0..0>
+
+Each process checks its OWN addressable shards (no full-state gather —
+the same discipline the big-state paths follow).  Exit code 0 from both
+workers = pass.  Run: python scripts/multihost_smoke.py
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+pid = int(sys.argv[1])
+jax.distributed.initialize(coordinator_address="127.0.0.1:%(port)d",
+                           num_processes=2, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.parallel import dist as PAR
+from quest_tpu.ops import paulis as PA
+
+devs = jax.devices()
+assert len(devs) == 8, devs
+mesh = Mesh(np.array(devs), (AMP_AXIS,))
+n = 12
+dim = 1 << n
+sh = NamedSharding(mesh, P(None, AMP_AXIS))
+
+def make_state(vec2):
+    # global (2, dim) array from a full host vector: each process
+    # materialises only its addressable shards
+    return jax.make_array_from_callback(
+        (2, dim), sh, lambda idx: vec2[idx])
+
+def local_shards(g):
+    return [(s.index, np.asarray(s.data)) for s in g.addressable_shards]
+
+def check(name, ok):
+    print(f"[p{pid}] {name}: {'ok' if ok else 'FAIL'}", flush=True)
+    if not ok:
+        sys.exit(1)
+
+rng = np.random.default_rng(0)   # same seed on both processes
+v = rng.standard_normal((2, dim))
+v /= np.sqrt((v ** 2).sum())
+
+# -- psum across the process boundary
+g = make_state(v)
+tp = PAR.total_prob_sharded(g, mesh=mesh)
+check("total_prob psum", abs(float(tp) - 1.0) < 1e-12)
+
+# -- ppermute exchange on the top qubit (owned by opposite processes)
+h = np.array([[[1, 1], [1, -1]], [[0, 0], [0, 0]]]) / np.sqrt(2)
+g = make_state(v)
+for _ in range(2):
+    g = PAR.apply_matrix_1q_sharded(
+        g, jnp.asarray(h), mesh=mesh, num_qubits=n, target=n - 1)
+before = {tuple(map(str, i)): d for i, d in
+          [(i, v[i]) for i, _ in local_shards(make_state(v))]}
+err = max(np.abs(d - v[i]).max() for i, d in local_shards(g))
+check("H^2 on cross-process qubit restores state", err < 1e-12)
+
+# -- QFT of |0..0> -> uniform amplitudes on every shard
+z = np.zeros((2, dim)); z[0, 0] = 1.0
+g = PAR.fused_qft_sharded(make_state(z), mesh=mesh, num_qubits=n)
+expect = 2.0 ** (-n / 2)
+err = 0.0
+for i, d in local_shards(g):
+    err = max(err, np.abs(d[0] - expect).max(), np.abs(d[1]).max())
+check("fused QFT -> uniform state", err < 1e-12)
+
+# -- Trotter stream then its inverse restores the state
+T = 6
+codes = rng.integers(0, 4, size=(T, n)).astype(np.int32)
+angles = rng.normal(size=T)
+g = make_state(v)
+g = PAR.trotter_scan_sharded(g, jnp.asarray(codes), jnp.asarray(angles),
+                             mesh=mesh, num_qubits=n, rep_qubits=n)
+g = PAR.trotter_scan_sharded(g, jnp.asarray(codes[::-1].copy()),
+                             jnp.asarray(-angles[::-1].copy()),
+                             mesh=mesh, num_qubits=n, rep_qubits=n)
+err = max(np.abs(d - v[i]).max() for i, d in local_shards(g))
+check("trotter + inverse restores state", err < 1e-10)
+
+# -- expectation of Z-strings on |0..0>: every Z/I term contributes its
+#    coefficient; an X/Y-containing term contributes 0
+codes_e = np.zeros((3, n), np.int32)
+codes_e[1, 0] = 3; codes_e[1, 5] = 3        # Z0 Z5
+codes_e[2, 2] = 1                           # X2 -> 0
+coeffs = np.array([0.5, 0.25, 10.0])
+e = PAR.expec_pauli_sum_scan_sharded(
+    make_state(z), jnp.asarray(codes_e), jnp.asarray(coeffs),
+    mesh=mesh, num_qubits=n)
+check("expec Z-strings across processes", abs(float(e) - 0.75) < 1e-12)
+
+print(f"[p{pid}] ALL OK", flush=True)
+"""
+
+
+def main():
+    port = 12431
+    src = WORKER % {"repo": REPO, "port": port}
+    path = "/tmp/qt_multihost_worker.py"
+    with open(path, "w") as f:
+        f.write(src)
+    procs = [subprocess.Popen([sys.executable, path, str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    ok = True
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        print(out)
+        ok &= (p.returncode == 0)
+    print("MULTIHOST SMOKE:", "PASS" if ok else "FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Multi-process (multi-host analogue) validation: two OS processes,
+gloo collectives over TCP — the DCN stand-in for the reference's
+inter-node MPI (QuEST_cpu_distributed.c).  Runs the distributed kernel
+layer across the process boundary; see scripts/multihost_smoke.py for
+what is checked."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multihost_smoke():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "multihost_smoke.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    assert "MULTIHOST SMOKE: PASS" in r.stdout
