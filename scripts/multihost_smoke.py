@@ -120,6 +120,38 @@ e = PAR.expec_pauli_sum_scan_sharded(
     mesh=mesh, num_qubits=n)
 check("expec Z-strings across processes", abs(float(e) - 0.75) < 1e-12)
 
+# -- the PUBLIC API end to end across processes: env discovery, a
+#    sharded register, gates, reductions, and the seeded measurement
+#    stream (same outcome on every process — the reference's broadcast-
+#    seed semantics, QuEST_cpu_distributed.c:1384-1395)
+import quest_tpu as qt
+env = qt.createQuESTEnv()
+check("createQuESTEnv spans processes", env.num_ranks == 8)
+q = qt.createQureg(n, env)
+qt.hadamard(q, 0)
+for t in range(1, n):
+    qt.controlledNot(q, t - 1, t)
+check("API GHZ prob",
+      abs(qt.calcProbOfOutcome(q, n - 1, 0) - 0.5) < 1e-6)  # f32 register
+qt.seedQuEST(env, [42])
+o1 = qt.measure(q, n - 1)
+outs, probs = qt.measureSequence(q, range(4))
+check("API measure + sequence ran", o1 in (0, 1) and len(outs) == 4)
+q2 = qt.createQureg(n, env)
+qt.applyFullQFT(q2)   # |0..0> -> uniform via the sharded fused QFT
+err = 0.0
+for i, d in local_shards(q2.amps):
+    err = max(err, np.abs(d[0] - expect).max(), np.abs(d[1]).max())
+check("API applyFullQFT (sharded route)", err < 1e-6)
+h3 = qt.createPauliHamil(n, 3)
+qt.initPauliHamil(h3, coeffs, codes_e)
+q3 = qt.createQureg(n, env)
+check("API calcExpecPauliHamil",
+      abs(qt.calcExpecPauliHamil(q3, h3) - 0.75) < 1e-6)
+qt.applyTrotterCircuit(q3, h3, 0.3, 1, 1)
+check("API applyTrotterCircuit totalProb",
+      abs(qt.calcTotalProb(q3) - 1.0) < 1e-6)
+
 print(f"[p{pid}] ALL OK", flush=True)
 """
 
