@@ -190,3 +190,26 @@ def test_channels_captured_under_fusion_match_eager(env):
     a = run(False)
     b = run(True)
     np.testing.assert_allclose(a, b, atol=1e-10)
+
+
+@pytest.mark.parametrize("q1", range(5))
+@pytest.mark.parametrize("q2", range(5))
+def test_mix_two_qubit_depolarising_all_pairs(env, rho_pair, q1, q2):
+    """Exhaustive geometry sweep of the round-4 dedicated orbit kernel
+    (local elementwise + sharded <=2-ppermute variants replace the
+    256x generic superoperator): every ordered target pair vs the
+    15-Pauli oracle."""
+    if q1 == q2:
+        pytest.skip("targets must differ")
+    r, mat = rho_pair
+    p = 0.45
+    qt.mixTwoQubitDepolarising(r, q1, q2, p)
+    expect = (1 - p) * mat
+    for i in range(4):
+        for j in range(4):
+            if i == 0 and j == 0:
+                continue
+            P1 = oracle.full_operator(N, [q1], oracle.PAULIS[i])
+            P2 = oracle.full_operator(N, [q2], oracle.PAULIS[j])
+            expect = expect + (p / 15) * (P1 @ P2 @ mat @ P2 @ P1)
+    np.testing.assert_allclose(oracle.state_from_qureg(r), expect, atol=ATOL)
