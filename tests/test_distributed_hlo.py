@@ -468,3 +468,28 @@ class TestTwoQubitChannelsExactCollectives:
             expect = expect + K2 @ mat @ K2.conj().T
         np.testing.assert_allclose(oracle.state_from_qureg(r), expect,
                                    atol=1e-10)
+
+
+class TestMeasurementCollectives:
+    def test_measure_fused_one_allreduce_no_gather(self, env8):
+        """The fused measure program on a sharded register: the prob
+        reduce lowers to all-reduce(s), the threshold draw is replicated
+        (key broadcast = the reference's seed broadcast,
+        QuEST_cpu_distributed.c:1384-1395), the conditional collapse is
+        elementwise — and the STATE is never gathered."""
+        import jax.random as jr
+
+        from quest_tpu.ops import measurement as M
+
+        n = 10
+        amps = sharded_state(env8, n, 40)
+        key = jr.PRNGKey(0)
+
+        def f(a):
+            out, o, p = M.measure_fused(
+                a, key, 3, num_qubits=n, target=n - 1, is_density=False)
+            return out, o, p
+
+        hist = collective_ops(f, amps, donate=True)
+        assert set(hist) <= {"all-reduce", "all-reduce-start"}, hist
+        assert 1 <= sum(hist.values()) <= 3, hist
