@@ -1,0 +1,80 @@
+"""30q QFT section timing by large-K contrast ((T[4x]-T[1x])/3): where
+do the 0.39-0.45 s go — the radix-4 high-layer sweeps, the cluster
+pass, the low-fold window pass, or the in-place palindromic reversal?
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("devices:", jax.devices(), flush=True)
+
+    from quest_tpu import circuit as C
+    from quest_tpu.models.circuits import amp00_canonical, zero_state_canonical
+    from quest_tpu.ops import fused
+
+    n = 30
+    res = {"n": n}
+    KHI = 4
+
+    def marginal(label, apply_once, reps=4):
+        def run_k(k):
+            a = zero_state_canonical(n)
+            t0 = time.perf_counter()
+            for _ in range(k):
+                a = apply_once(a)
+            float(amp00_canonical(a))  # layout-safe sync
+            return time.perf_counter() - t0
+
+        run_k(1)
+        run_k(KHI)
+        ds = []
+        for _ in range(reps):
+            t1 = run_k(1)
+            t4 = run_k(KHI)
+            ds.append((t4 - t1) / (KHI - 1))
+        ds.sort()
+        res[label] = {"median": round(ds[len(ds) // 2], 4),
+                      "min": round(min(ds), 4)}
+        print(label, res[label], flush=True)
+
+    # whole QFT
+    marginal("full_qft", lambda a: C.fused_qft(a, n, 0, n))
+
+    # high layers only (radix-4 multi_hi sweeps, t = 29..14)
+    def high_only(a):
+        # the canonical 4-d view IS the (2, HI, 128, 128) shape the
+        # kernel uses: pass it directly (an EAGER reshape would relayout
+        # the whole 8 GB state -- the exact trap ops/element.py guards)
+        return fused.apply_qft_multilayer_ladders(
+            a, num_qubits=n, t_top=n - 1)
+
+    marginal("high_plus_cluster", high_only)
+
+    # reversal only (the in-place palindromic path: 4 window passes +
+    # sigma_swap DMA)
+    rev_ops = C.bit_reversal_ops(n, [(0, n)], np.float32)
+
+    def rev_only(a):
+        return C.execute_plan(a, rev_ops, n)
+
+    marginal("bit_reversal", rev_only)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_qft30_sections_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()
