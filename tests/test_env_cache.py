@@ -1,0 +1,58 @@
+"""Compilation-cache wiring decisions (env._enable_compilation_cache):
+opt-out env var, user-configured locations respected, CPU-backend skip
+(cross-host AOT entries can SIGILL)."""
+
+import jax
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import env as E
+
+
+@pytest.fixture(autouse=True)
+def _reset_wired(monkeypatch):
+    monkeypatch.setattr(E, "_CACHE_WIRED", [False])
+    yield
+
+
+def _configured():
+    return jax.config.jax_compilation_cache_dir
+
+
+def test_opt_out(monkeypatch):
+    monkeypatch.setenv("QT_NO_COMPILE_CACHE", "1")
+    before = _configured()
+    E._enable_compilation_cache()
+    assert _configured() == before
+    assert E._CACHE_WIRED == [False]  # may re-wire later without opt-out
+
+
+def test_respects_user_jax_env_var(monkeypatch):
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/userspot")
+    before = _configured()
+    E._enable_compilation_cache()
+    assert _configured() == before  # never overridden
+
+
+def test_cpu_backend_skipped_by_default(monkeypatch):
+    monkeypatch.delenv("QT_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("QT_COMPILE_CACHE_DIR", raising=False)
+    if jax.config.jax_compilation_cache_dir:
+        pytest.skip("cache already configured in this session")
+    assert jax.default_backend() == "cpu"  # test harness forces CPU
+    E._enable_compilation_cache()
+    assert _configured() is None
+
+
+def test_explicit_dir_forces_on_cpu(monkeypatch, tmp_path):
+    monkeypatch.delenv("QT_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    if jax.config.jax_compilation_cache_dir:
+        pytest.skip("cache already configured in this session")
+    monkeypatch.setenv("QT_COMPILE_CACHE_DIR", str(tmp_path / "qc"))
+    try:
+        E._enable_compilation_cache()
+        assert _configured() == str(tmp_path / "qc")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
