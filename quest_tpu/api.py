@@ -37,7 +37,7 @@ from .ops import gatedefs as G
 from .ops import kernels as K
 from .ops import paulis as P
 from .ops import phasefunc as PF
-from .precision import complex_dtype, real_dtype, real_eps
+from .precision import complex_dtype, real_dtype, validation_eps
 from .qureg import DiagonalOp, PauliHamil, Qureg
 
 # pauliOpType (QuEST.h:96)
@@ -594,7 +594,7 @@ def compactUnitary(qureg: Qureg, targetQubit: int, alpha, beta) -> None:
     """Apply the compact unitary [[alpha, -conj(beta)], [beta, conj(alpha)]] (QuEST.h:2141)."""
     V.validate_target(qureg, targetQubit, "compactUnitary")
     alpha, beta = complex(alpha), complex(beta)
-    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
+    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * validation_eps():
         raise V.QuESTError("compactUnitary: Compact matrix formed by given complex numbers is not unitary.")
     m = G.compact_unitary_matrix(alpha, beta)
     _apply_unitary(qureg, m, (targetQubit,))
@@ -674,7 +674,7 @@ def controlledCompactUnitary(qureg, controlQubit, targetQubit, alpha, beta) -> N
     """Controlled compact unitary (QuEST.h:2537)."""
     V.validate_control_target(qureg, controlQubit, targetQubit, "controlledCompactUnitary")
     alpha, beta = complex(alpha), complex(beta)
-    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * real_eps():
+    if abs(abs(alpha) ** 2 + abs(beta) ** 2 - 1) > 64 * validation_eps():
         raise V.QuESTError("controlledCompactUnitary: Compact matrix formed by given complex numbers is not unitary.")
     _apply_unitary(qureg, G.compact_unitary_matrix(alpha, beta), (targetQubit,), (controlQubit,))
     qureg.qasm_log.unitary_2x2(

@@ -19,7 +19,7 @@ from .ops import density as D
 from .ops import kernels as K
 from .ops import paulis as P
 from .ops import phasefunc as PF
-from .precision import real_eps
+from .precision import get_precision, real_eps
 from .qureg import DiagonalOp, PauliHamil, Qureg
 from .rng import GLOBAL_RNG
 from .api import (
@@ -32,6 +32,12 @@ from .api import (
     swapGate,
 )
 
+
+
+def _quad() -> bool:
+    """prec-4: route reductions through double-double accumulation."""
+    return get_precision() == 4
+
 # ---------------------------------------------------------------------------
 # Measurement (QuEST.c:985-995, QuEST_common.c:168-183,374-380)
 # ---------------------------------------------------------------------------
@@ -41,15 +47,15 @@ def calcProbOfOutcome(qureg: Qureg, measureQubit: int, outcome: int) -> float:
     """Probability of measuring the given outcome of one qubit (QuEST.h:3047)."""
     V.validate_target(qureg, measureQubit, "calcProbOfOutcome")
     V.validate_outcome(outcome, "calcProbOfOutcome")
+    quad = _quad()
     if qureg.is_density_matrix:
         p = C.calc_prob_of_outcome_density(
             qureg.amps, num_qubits=qureg.num_qubits_represented,
-            target=measureQubit, outcome=outcome,
-        )
+            target=measureQubit, outcome=outcome, quad=quad)
     else:
         p = C.calc_prob_of_outcome_statevec(
-            qureg.amps, num_qubits=_sv_n(qureg), target=measureQubit, outcome=outcome
-        )
+            qureg.amps, num_qubits=_sv_n(qureg), target=measureQubit,
+            outcome=outcome, quad=quad)
     return float(p)
 
 
@@ -130,7 +136,8 @@ def measureWithStats(qureg: Qureg, measureQubit: int):
     key, shot = M.KEYS.next_shots()
     amps, outcome, prob = M.measure_fused(
         qureg.amps, key, shot, num_qubits=qureg.num_qubits_represented,
-        target=measureQubit, is_density=qureg.is_density_matrix)
+        target=measureQubit, is_density=qureg.is_density_matrix,
+        quad=_quad())
     qureg.amps = amps
     qureg.qasm_log.measure(measureQubit)
     return int(outcome), float(prob)
@@ -162,7 +169,8 @@ def measureSequence(qureg: Qureg, qubits: Sequence[int]):
     key, shot = M.KEYS.next_shots(len(qubits))
     amps, outs, probs = M.measure_sequence(
         qureg.amps, key, shot, num_qubits=qureg.num_qubits_represented,
-        targets=tuple(qubits), is_density=qureg.is_density_matrix)
+        targets=tuple(qubits), is_density=qureg.is_density_matrix,
+        quad=_quad())
     qureg.amps = amps
     for q in qubits:
         qureg.qasm_log.measure(q)
@@ -442,16 +450,14 @@ def calcTotalProb(qureg: Qureg) -> float:
     (QuEST.h:2099).  Quad precision (set_precision(4)) accumulates in
     double-double (C.quad_sum — the QuEST_PREC=4 scope decision,
     precision.set_precision docstring)."""
-    from .precision import get_precision
-
     if qureg.is_density_matrix:
-        if get_precision() == 4:
+        if _quad():
             return float(C.calc_total_prob_density_quad(
                 qureg.amps, num_qubits=qureg.num_qubits_represented))
         return float(
             C.calc_total_prob_density(qureg.amps, num_qubits=qureg.num_qubits_represented)
         )
-    if get_precision() == 4:
+    if _quad():
         return float(C.calc_total_prob_statevec_quad(qureg.amps))
     return float(C.calc_total_prob_statevec(qureg.amps))
 
@@ -461,9 +467,7 @@ def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
     V.validate_state_vector(bra, "calcInnerProduct")
     V.validate_state_vector(ket, "calcInnerProduct")
     V.validate_matching_qureg_dims(bra, ket, "calcInnerProduct")
-    from .precision import get_precision
-
-    if get_precision() == 4:
+    if _quad():
         r = np.asarray(C.calc_inner_product_quad(bra.amps, ket.amps))
     else:
         r = np.asarray(C.calc_inner_product(bra.amps, ket.amps))
@@ -475,26 +479,27 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
     V.validate_density_matrix(rho1, "calcDensityInnerProduct")
     V.validate_density_matrix(rho2, "calcDensityInnerProduct")
     V.validate_matching_qureg_dims(rho1, rho2, "calcDensityInnerProduct")
-    return float(C.calc_density_inner_product(rho1.amps, rho2.amps))
+    return float(C.calc_density_inner_product(
+        rho1.amps, rho2.amps, quad=_quad()))
 
 
 def calcPurity(qureg: Qureg) -> float:
     """Purity Tr(rho^2) of a density matrix (QuEST.h:3692)."""
     V.validate_density_matrix(qureg, "calcPurity")
-    return float(C.calc_purity(qureg.amps))
+    return float(C.calc_purity(qureg.amps, quad=_quad()))
 
 
 def calcFidelity(qureg: Qureg, pureState: Qureg) -> float:
     """Fidelity of a register against a pure reference state (QuEST.h:3724)."""
     V.validate_second_qureg_state_vec(pureState, "calcFidelity")
     V.validate_matching_qureg_dims(qureg, pureState, "calcFidelity")
+    quad = _quad()
     if qureg.is_density_matrix:
-        return float(
-            C.calc_fidelity_density(
-                qureg.amps, pureState.amps, num_qubits=qureg.num_qubits_represented
-            )
-        )
-    ip = np.asarray(C.calc_inner_product(qureg.amps, pureState.amps))
+        return float(C.calc_fidelity_density(
+            qureg.amps, pureState.amps,
+            num_qubits=qureg.num_qubits_represented, quad=quad))
+    ip_fn = C.calc_inner_product_quad if quad else C.calc_inner_product
+    ip = np.asarray(ip_fn(qureg.amps, pureState.amps))
     return float(ip[0] ** 2 + ip[1] ** 2)
 
 
@@ -503,7 +508,8 @@ def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
     V.validate_density_matrix(a, "calcHilbertSchmidtDistance")
     V.validate_density_matrix(b, "calcHilbertSchmidtDistance")
     V.validate_matching_qureg_dims(a, b, "calcHilbertSchmidtDistance")
-    return float(C.calc_hilbert_schmidt_distance(a.amps, b.amps))
+    return float(C.calc_hilbert_schmidt_distance(
+        a.amps, b.amps, quad=_quad()))
 
 
 def _spans_mesh(qureg: Qureg) -> bool:
@@ -556,15 +562,16 @@ def calcExpecPauliProd(qureg: Qureg, targetQubits, pauliCodes, workspace: Option
     V.validate_pauli_codes(codes, "calcExpecPauliProd")
     coeffs = np.ones(1)
     flat = _full_codes(qureg, targets, codes)
+    quad = _quad()
     if qureg.is_density_matrix:
         val = P.calc_expec_pauli_sum_density(
             qureg.amps, coeffs, num_qubits=qureg.num_qubits_represented,
-            codes_flat=flat, num_terms=1,
+            codes_flat=flat, num_terms=1, quad=quad,
         )
     else:
         val = P.calc_expec_pauli_sum_statevec(
             qureg.amps, coeffs, num_qubits=qureg.num_qubits_represented,
-            codes_flat=flat, num_terms=1,
+            codes_flat=flat, num_terms=1, quad=quad,
         )
     return float(val)
 
@@ -580,16 +587,18 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
         raise V.QuESTError("calcExpecPauliSum: Number of Pauli codes doesn't match numSumTerms*numQubits.")
     V.validate_pauli_codes(codes, "calcExpecPauliSum")
     cj = coeffs
+    quad = _quad()
     if qureg.is_density_matrix:
         val = P.calc_expec_pauli_sum_density(
-            qureg.amps, cj, num_qubits=n, codes_flat=codes, num_terms=num_terms
+            qureg.amps, cj, num_qubits=n, codes_flat=codes,
+            num_terms=num_terms, quad=quad
         )
     elif _gspmd_pallas_unsafe(qureg) and not _explicit_sharded(qureg):
         # opted-out GSPMD mode on a real TPU mesh: the scan's Pallas
         # product layers cannot partition there — per-term kernels
         val = P.calc_expec_pauli_sum_statevec(
             qureg.amps, cj, num_qubits=n, codes_flat=codes,
-            num_terms=num_terms,
+            num_terms=num_terms, quad=quad,
         )
     else:
         # scan over the term table: one compiled body regardless of term
@@ -602,10 +611,11 @@ def calcExpecPauliSum(qureg: Qureg, allPauliCodes, termCoeffs, workspace: Option
             from .parallel import dist as PAR
             val = PAR.expec_pauli_sum_scan_sharded(
                 qureg.amps, codes_seq, jnp.asarray(cj),
-                mesh=qureg.env.mesh, num_qubits=n)
+                mesh=qureg.env.mesh, num_qubits=n, quad=quad)
         else:
             val = P.expec_pauli_sum_scan(
-                qureg.amps, codes_seq, jnp.asarray(cj), num_qubits=n
+                qureg.amps, codes_seq, jnp.asarray(cj), num_qubits=n,
+                quad=quad,
             )
     return float(val)
 
@@ -620,14 +630,14 @@ def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace: Optional[Qur
 def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
     """Expected value of a diagonal operator in the given state (QuEST.h:1255)."""
     V.validate_diag_op_matches_qureg(op, qureg, "calcExpecDiagonalOp")
+    quad = _quad()
     if qureg.is_density_matrix:
-        r = np.asarray(
-            C.calc_expec_diagonal_density(
-                qureg.amps, op.real, op.imag, num_qubits=qureg.num_qubits_represented
-            )
-        )
+        r = np.asarray(C.calc_expec_diagonal_density(
+            qureg.amps, op.real, op.imag,
+            num_qubits=qureg.num_qubits_represented, quad=quad))
     else:
-        r = np.asarray(C.calc_expec_diagonal_statevec(qureg.amps, op.real, op.imag))
+        r = np.asarray(C.calc_expec_diagonal_statevec(
+            qureg.amps, op.real, op.imag, quad=quad))
     return complex(r[0], r[1])
 
 

@@ -91,17 +91,23 @@ def _enable_compilation_cache() -> None:
     if _CACHE_WIRED[0] or os.environ.get("QT_NO_COMPILE_CACHE") == "1":
         return
     _CACHE_WIRED[0] = True
-    # respect a user-configured cache location (standard JAX env var or
-    # an explicit jax.config set before createQuESTEnv)
-    if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
-            or jax.config.jax_compilation_cache_dir):
-        return
-    # CPU AOT cache entries embed the compile host's microarch features
-    # and can SIGILL on a different host (XLA warns on load); the compile
-    # cost being killed is the accelerator programs' anyway — default the
-    # cache on only off-CPU (QT_COMPILE_CACHE_DIR forces it on anywhere)
-    if (jax.default_backend() == "cpu"
-            and "QT_COMPILE_CACHE_DIR" not in os.environ):
+    try:
+        # respect a user-configured cache location (standard JAX env var
+        # or an explicit jax.config set before createQuESTEnv); inside
+        # the try so a JAX version lacking the config attribute skips the
+        # best-effort cache instead of breaking createQuESTEnv
+        if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or jax.config.jax_compilation_cache_dir):
+            return
+        # CPU AOT cache entries embed the compile host's microarch
+        # features and can SIGILL on a different host (XLA warns on
+        # load); the compile cost being killed is the accelerator
+        # programs' anyway — default the cache on only off-CPU
+        # (QT_COMPILE_CACHE_DIR forces it on anywhere)
+        if (jax.default_backend() == "cpu"
+                and "QT_COMPILE_CACHE_DIR" not in os.environ):
+            return
+    except Exception:  # pragma: no cover - cache is best-effort
         return
     cache_dir = os.environ.get(
         "QT_COMPILE_CACHE_DIR",

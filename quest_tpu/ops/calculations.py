@@ -41,6 +41,35 @@ def calc_total_prob_statevec(amps):
 _QUAD_BLOCK = 256
 
 
+def neumaier_sum(vals):
+    """Neumaier error-free-transform scan over a 1-D vector: the serial
+    double-double combine used on block partials (quad_sum) and on small
+    signed sequences (per-term expectation contributions)."""
+
+    def body(carry, v):
+        s, c = carry
+        t = s + v
+        c = c + jnp.where(jnp.abs(s) >= jnp.abs(v),
+                          (s - t) + v, (v - t) + s)
+        return (t, c), None
+
+    z = jnp.zeros((), vals.dtype)
+    (s, c), _ = jax.lax.scan(body, (z, z), vals)
+    return s + c
+
+
+def quad_sum2(x, y):
+    """Channel-split compensated sum: quad_sum(x) + quad_sum(y).
+
+    THE invariant for every two-channel quad reduction (inner products,
+    norms, signed expectation summands): the two product grids enter
+    SEPARATE compensated sums — a per-element f64 pre-add of x + y
+    would round the smaller channel's contribution away before
+    compensation ever sees it (the failure class the prec-4 contract
+    exists to prevent)."""
+    return quad_sum(x) + quad_sum(y)
+
+
 def quad_sum(x):
     """Double-double-compensated sum of a vector — the quad-precision
     (QuEST_PREC=4, QuEST_precision.h:55-68) accumulation mode for the
@@ -58,22 +87,12 @@ def quad_sum(x):
     # otherwise be a 262k-step scalar chain)
     if nb > _QUAD_BLOCK:
         partials = partials.reshape(_QUAD_BLOCK, -1).sum(axis=1)
-
-    def body(carry, v):
-        s, c = carry
-        t = s + v
-        c = c + jnp.where(jnp.abs(s) >= jnp.abs(v),
-                          (s - t) + v, (v - t) + s)
-        return (t, c), None
-
-    z = jnp.zeros((), flat.dtype)
-    (s, c), _ = jax.lax.scan(body, (z, z), partials)
-    return s + c
+    return neumaier_sum(partials)
 
 
 @jax.jit
 def calc_total_prob_statevec_quad(amps):
-    return quad_sum(cplx.abs2(amps))
+    return quad_sum2(amps[0] * amps[0], amps[1] * amps[1])
 
 
 @partial(jax.jit, static_argnames=("num_qubits",))
@@ -87,9 +106,16 @@ def calc_inner_product_quad(bra_amps, ket_amps):
     case where cross-block cancellation actually bites)."""
     br, bi = bra_amps[0], bra_amps[1]
     kr, ki = ket_amps[0], ket_amps[1]
-    re = quad_sum(br * kr) + quad_sum(bi * ki)
-    im = quad_sum(br * ki) - quad_sum(bi * kr)
+    re = quad_sum2(br * kr, bi * ki)
+    im = quad_sum2(br * ki, -(bi * kr))
     return jnp.stack([re, im])
+
+
+# The remaining observable reductions take a static ``quad`` flag
+# selecting the double-double reducer — ONE kernel body per family, so
+# the prec-4 path cannot diverge from the plain one.  The reference's
+# QuEST_PREC=4 makes EVERY calc* accumulate in long double
+# (QuEST_precision.h:55-68; QuEST_cpu.c:861-1071, 3363-3645).
 
 
 def _diag(amps, num_qubits: int):
@@ -106,19 +132,25 @@ def calc_total_prob_density(amps, *, num_qubits: int):
     return jnp.sum(_diag(amps, num_qubits)[0])
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "target", "outcome"))
-def calc_prob_of_outcome_statevec(amps, *, num_qubits: int, target: int, outcome: int):
+@partial(jax.jit, static_argnames=("num_qubits", "target", "outcome",
+                                   "quad"))
+def calc_prob_of_outcome_statevec(amps, *, num_qubits: int, target: int,
+                                  outcome: int, quad: bool = False):
     """(statevec_calcProbOfOutcome, QuEST_cpu.c:3418-3508)."""
     from .kernels import bit_indicator_2d
 
     n = num_qubits
     ind = bit_indicator_2d(n, ((target, outcome),), amps.dtype)
     view = amps.reshape(2, ind.shape[0], ind.shape[1])
+    if quad:
+        return quad_sum2(view[0] * view[0] * ind, view[1] * view[1] * ind)
     return jnp.sum(cplx.abs2(view) * ind)
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "target", "outcome"))
-def calc_prob_of_outcome_density(amps, *, num_qubits: int, target: int, outcome: int):
+@partial(jax.jit, static_argnames=("num_qubits", "target", "outcome",
+                                   "quad"))
+def calc_prob_of_outcome_density(amps, *, num_qubits: int, target: int,
+                                 outcome: int, quad: bool = False):
     """Sum of diagonal rho elements whose target bit equals outcome
     (densmatr_calcProbOfOutcome via findProbabilityOfZero,
     QuEST_cpu.c:3363-3417)."""
@@ -127,7 +159,8 @@ def calc_prob_of_outcome_density(amps, *, num_qubits: int, target: int, outcome:
     n = num_qubits
     diag_re = _diag(amps, num_qubits)[0]
     ind = bit_indicator_2d(n, ((target, outcome),), amps.dtype)
-    return jnp.sum(diag_re.reshape(ind.shape) * ind)
+    red = quad_sum if quad else jnp.sum
+    return red(diag_re.reshape(ind.shape) * ind)
 
 
 def _outcome_histogram(vals, n: int, qubits: Tuple[int, ...]):
@@ -196,26 +229,43 @@ def calc_inner_product(bra_amps, ket_amps):
     return cplx.vdot(bra_amps, ket_amps)
 
 
-@jax.jit
-def calc_density_inner_product(rho1_amps, rho2_amps):
+@partial(jax.jit, static_argnames=("quad",))
+def calc_density_inner_product(rho1_amps, rho2_amps, *, quad: bool = False):
     """Tr(rho1^dagger rho2) real part (densmatr_calcInnerProductLocal,
     QuEST_cpu.c:958)."""
+    if quad:
+        return quad_sum2(rho1_amps[0] * rho2_amps[0],
+                         rho1_amps[1] * rho2_amps[1])
     return jnp.sum(rho1_amps[0] * rho2_amps[0] + rho1_amps[1] * rho2_amps[1])
 
 
-@jax.jit
-def calc_purity(rho_amps):
+@partial(jax.jit, static_argnames=("quad",))
+def calc_purity(rho_amps, *, quad: bool = False):
     """Tr(rho^2) = sum |rho_rc|^2 for Hermitian rho (calcPurityLocal,
     QuEST_cpu.c:861)."""
+    if quad:
+        return quad_sum2(rho_amps[0] * rho_amps[0],
+                         rho_amps[1] * rho_amps[1])
     return jnp.sum(cplx.abs2(rho_amps))
 
 
-@partial(jax.jit, static_argnames=("num_qubits",))
-def calc_fidelity_density(rho_amps, psi_amps, *, num_qubits: int):
-    """<psi|rho|psi> (densmatr_calcFidelityLocal, QuEST_cpu.c:990)."""
+@partial(jax.jit, static_argnames=("num_qubits", "quad"))
+def calc_fidelity_density(rho_amps, psi_amps, *, num_qubits: int,
+                          quad: bool = False):
+    """<psi|rho|psi> (densmatr_calcFidelityLocal, QuEST_cpu.c:990).
+
+    Quad switches to the fully elementwise form: w_{rc} =
+    Re[conj(psi_r) rho_{rc} psi_c] quad-summed over ALL dim^2 terms, so
+    the signed cross terms see double-double accumulation end-to-end
+    (the matmul form would round the inner contraction at f64)."""
     dim = 1 << num_qubits
     m = rho_amps.reshape(2, dim, dim)  # [channel, col, row]; m[., c, r] = rho_{r,c}
     p0, p1 = psi_amps[0], psi_amps[1]
+    if quad:
+        # conj(psi_r) psi_c = A[c,r] + i B[c,r]
+        a = p0[:, None] * p0[None, :] + p1[:, None] * p1[None, :]
+        b = p1[:, None] * p0[None, :] - p0[:, None] * p1[None, :]
+        return quad_sum2(m[0] * a, -(m[1] * b))
     hi = jax.lax.Precision.HIGHEST
     # v_c = sum_r rho_{r,c} conj(psi_r)
     v_re = jnp.matmul(m[0], p0, precision=hi) + jnp.matmul(m[1], p1, precision=hi)
@@ -224,27 +274,42 @@ def calc_fidelity_density(rho_amps, psi_amps, *, num_qubits: int):
     return jnp.sum(p0 * v_re - p1 * v_im)
 
 
-@jax.jit
-def calc_hilbert_schmidt_distance(rho1_amps, rho2_amps):
+@partial(jax.jit, static_argnames=("quad",))
+def calc_hilbert_schmidt_distance(rho1_amps, rho2_amps, *,
+                                  quad: bool = False):
     """sqrt(sum |rho1-rho2|^2) (calcHilbertSchmidtDistanceSquaredLocal,
     QuEST_cpu.c:923)."""
-    return jnp.sqrt(jnp.sum(cplx.abs2(rho1_amps - rho2_amps)))
+    d = rho1_amps - rho2_amps
+    if quad:
+        return jnp.sqrt(quad_sum2(d[0] * d[0], d[1] * d[1]))
+    return jnp.sqrt(jnp.sum(cplx.abs2(d)))
 
 
-@jax.jit
-def calc_expec_diagonal_statevec(amps, op_real, op_imag):
+@partial(jax.jit, static_argnames=("quad",))
+def calc_expec_diagonal_statevec(amps, op_real, op_imag, *,
+                                 quad: bool = False):
     """sum_i |amp_i|^2 d_i -> stacked (2,) (statevec_calcExpecDiagonalOp,
     QuEST_cpu.c:4094-4126)."""
+    if quad:
+        sq0, sq1 = amps[0] * amps[0], amps[1] * amps[1]
+        return jnp.stack(
+            [quad_sum2(sq0 * op_real, sq1 * op_real),
+             quad_sum2(sq0 * op_imag, sq1 * op_imag)])
     p = cplx.abs2(amps)
     return jnp.stack([jnp.sum(p * op_real), jnp.sum(p * op_imag)])
 
 
-@partial(jax.jit, static_argnames=("num_qubits",))
-def calc_expec_diagonal_density(amps, op_real, op_imag, *, num_qubits: int):
+@partial(jax.jit, static_argnames=("num_qubits", "quad"))
+def calc_expec_diagonal_density(amps, op_real, op_imag, *, num_qubits: int,
+                                quad: bool = False):
     """sum_r d_r rho_rr -> stacked (2,) — diagonal elements are node-local by
     construction in the reference (densmatr_calcExpecDiagonalOp,
     QuEST_cpu.c:4127-4186)."""
     d = _diag(amps, num_qubits)
+    if quad:
+        return jnp.stack(
+            [quad_sum2(d[0] * op_real, -(d[1] * op_imag)),
+             quad_sum2(d[0] * op_imag, d[1] * op_real)])
     re = jnp.sum(d[0] * op_real - d[1] * op_imag)
     im = jnp.sum(d[0] * op_imag + d[1] * op_real)
     return jnp.stack([re, im])

@@ -138,16 +138,18 @@ def _draw_outcome(p0, key, shot, dt):
 
 
 def _measure_once(amps, key, shot, num_qubits: int, target: int,
-                  is_density: bool):
+                  is_density: bool, quad: bool = False):
     from . import calculations as C
 
     dt = amps.dtype
     if is_density:
         p0 = C.calc_prob_of_outcome_density(
-            amps, num_qubits=num_qubits, target=target, outcome=0)
+            amps, num_qubits=num_qubits, target=target, outcome=0,
+            quad=quad)
     else:
         p0 = C.calc_prob_of_outcome_statevec(
-            amps, num_qubits=num_qubits, target=target, outcome=0)
+            amps, num_qubits=num_qubits, target=target, outcome=0,
+            quad=quad)
     outcome, prob = _draw_outcome(p0, key, shot, dt)
     if is_density:
         amps = _collapse_traced_dm(amps, num_qubits, target, outcome, prob)
@@ -157,22 +159,27 @@ def _measure_once(amps, key, shot, num_qubits: int, target: int,
 
 
 @partial(jax.jit,
-         static_argnames=("num_qubits", "target", "is_density"),
+         static_argnames=("num_qubits", "target", "is_density", "quad"),
          donate_argnums=0)
 def measure_fused(amps, key, shot, *, num_qubits: int, target: int,
-                  is_density: bool):
+                  is_density: bool, quad: bool = False):
     """One measurement shot as one compiled program: probability reduce,
     on-device threshold draw, conditional collapse.  Returns
     (new_amps, outcome int32, outcome probability).  ``num_qubits`` is
-    the REPRESENTED count (state bits = 2x for a density matrix)."""
-    return _measure_once(amps, key, shot, num_qubits, target, is_density)
+    the REPRESENTED count (state bits = 2x for a density matrix).
+    ``quad`` (prec 4) runs the probability reduce in double-double, so
+    the fused path honours the same accumulation contract as
+    calcProbOfOutcome."""
+    return _measure_once(amps, key, shot, num_qubits, target, is_density,
+                         quad)
 
 
 @partial(jax.jit,
-         static_argnames=("num_qubits", "targets", "is_density"),
+         static_argnames=("num_qubits", "targets", "is_density", "quad"),
          donate_argnums=0)
 def measure_sequence(amps, key, shot, *, num_qubits: int,
-                     targets: Tuple[int, ...], is_density: bool):
+                     targets: Tuple[int, ...], is_density: bool,
+                     quad: bool = False):
     """Measure a SEQUENCE of qubits in one compiled program — each step
     collapses before the next qubit's probability is computed, exactly as
     a loop of measure() calls would, but with a single dispatch for the
@@ -183,7 +190,7 @@ def measure_sequence(amps, key, shot, *, num_qubits: int,
     outs, probs = [], []
     for j, t in enumerate(targets):
         amps, o, p = _measure_once(amps, key, shot + j, num_qubits, t,
-                                   is_density)
+                                   is_density, quad)
         outs.append(o)
         probs.append(p)
     return amps, jnp.stack(outs), jnp.stack(probs)

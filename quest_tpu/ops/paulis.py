@@ -66,39 +66,55 @@ def apply_pauli_prod(amps, *, num_qubits: int, targets: Tuple[int, ...], codes: 
     return apply_pauli_string(amps, num_qubits, targets, codes)
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms"))
+@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms",
+                                   "quad"))
 def calc_expec_pauli_sum_statevec(amps, coeffs, *, num_qubits: int,
-                                  codes_flat: Tuple[int, ...], num_terms: int):
+                                  codes_flat: Tuple[int, ...], num_terms: int,
+                                  quad: bool = False):
     """Re <psi| sum_t c_t P_t |psi> as ONE fused program (reference loops
-    clone+apply+innerProduct per term, QuEST_common.c:534-546)."""
+    clone+apply+innerProduct per term, QuEST_common.c:534-546).  ``quad``
+    (prec 4) accumulates each term's signed inner product — and the
+    cross-term combine — in double-double."""
+    from . import calculations as _calc
+
     n = num_qubits
     coeffs = jnp.asarray(coeffs, amps.dtype)
-    total = jnp.zeros((), amps.dtype)
+    vals = []
     for t in range(num_terms):
         codes = codes_flat[t * n:(t + 1) * n]
         pv = apply_pauli_string(amps, n, tuple(range(n)), codes)
         # Re <amps|pv>
-        total = total + coeffs[t] * jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
-    return total
+        if quad:
+            r = _calc.quad_sum2(amps[0] * pv[0], amps[1] * pv[1])
+        else:
+            r = jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
+        vals.append(coeffs[t] * r)
+    stacked = jnp.stack(vals)
+    return _calc.neumaier_sum(stacked) if quad else jnp.sum(stacked)
 
 
-@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms"))
+@partial(jax.jit, static_argnames=("num_qubits", "codes_flat", "num_terms",
+                                   "quad"))
 def calc_expec_pauli_sum_density(amps, coeffs, *, num_qubits: int,
-                                 codes_flat: Tuple[int, ...], num_terms: int):
+                                 codes_flat: Tuple[int, ...], num_terms: int,
+                                 quad: bool = False):
     """Re Tr(rho sum_t c_t P_t): apply P to the ket qubits of the flattened
     rho, then take the diagonal trace (reference routes this through
     densmatr_calcTotalProb of a workspace, QuEST_common.c:519-546)."""
+    from . import calculations as _calc
+
     n = num_qubits
     nn = 2 * n
     dim = 1 << n
     coeffs = jnp.asarray(coeffs, amps.dtype)
-    total = jnp.zeros((), amps.dtype)
+    red = _calc.quad_sum if quad else jnp.sum
+    vals = []
     for t in range(num_terms):
         codes = codes_flat[t * n:(t + 1) * n]
         pv = apply_pauli_string(amps, nn, tuple(range(n)), codes)
-        tr_re = jnp.sum(jnp.diagonal(pv[0].reshape(dim, dim)))
-        total = total + coeffs[t] * tr_re
-    return total
+        vals.append(coeffs[t] * red(jnp.diagonal(pv[0].reshape(dim, dim))))
+    stacked = jnp.stack(vals)
+    return _calc.neumaier_sum(stacked) if quad else jnp.sum(stacked)
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "num_state_qubits", "codes_flat", "num_terms"), donate_argnums=2)
@@ -273,10 +289,119 @@ def make_expec_term_value(dt, n: int, layer, signed_norm):
             codes, coeff = inp
             phi = layer(amps, tab[codes])
             zlo, zhi = _zmask_halves(codes, 0, n)
-            return acc + coeff.astype(dt) * signed_norm(phi, zlo, zhi), None
+            v = coeff.astype(dt) * signed_norm(phi, zlo, zhi)
+            # per-term value also emitted as scan output so the quad
+            # path can Neumaier-combine ACROSS terms instead of trusting
+            # the f64 carry accumulation
+            return acc + v, v
         return body
 
     return body_of
+
+
+# ---------------------------------------------------------------------------
+# Direct Pauli rotation: e^{-i th/2 P} psi = cos(th/2) psi
+#                                            - i sin(th/2) (P psi)
+# with (P psi)[i] = (-i)^{#Y} * (-1)^{parity(i & zm)} * psi[i ^ fm]
+# (fm = X|Y bits, zm = Z|Y bits, P^2 = I).  ONE split-axis gather + one
+# fused elementwise combine per term — measured ~2.2 ms/term at 24q vs
+# ~17 ms/term for the rotate-layer -> parity-phase -> unrotate-layer
+# body it replaces (scripts/probes/probe_trotter_direct_result.json:
+# direct_rowcol 0.0345 s vs window_scan 0.277 s for 16 terms, same
+# session; a flat 2^24 gather is ~160x slower — the (hi, lo) row/lane
+# split is what makes the permutation DMA-friendly).  The reference's
+# multiRotatePauli instead conjugates by basis rotations
+# (QuEST_common.c:424-462).
+# ---------------------------------------------------------------------------
+
+_GATHER_LO_BITS = 12   # lane-axis width of the split gather (4096)
+_DIRECT_MAX_N = 43     # hi-axis iota must stay below 2^31 rows
+
+
+def _direct_masks(codes, nq: int, offset: int, n: int):
+    """(fm_lo, fm_hi, zlo, zhi, ny) for a Pauli-code row acting on qubits
+    [offset, offset+nq): the flip mask split at _GATHER_LO_BITS for the
+    row/lane gather, the parity mask split at _PAR_LO_BITS for the sign,
+    and the Y count for the (-i)^{#Y} factor."""
+    lo = min(_GATHER_LO_BITS, n)
+    fm_lo = jnp.uint32(0)
+    fm_hi = jnp.uint32(0)
+    zlo = jnp.uint32(0)
+    zhi = jnp.uint32(0)
+    ny = jnp.uint32(0)
+    for q in range(nq):
+        c = codes[q]
+        is_x = (c == PAULI_X).astype(jnp.uint32)
+        is_y = (c == PAULI_Y).astype(jnp.uint32)
+        is_z = (c == PAULI_Z).astype(jnp.uint32)
+        pos = q + offset
+        fbit = is_x | is_y
+        if pos < lo:
+            fm_lo = fm_lo | (fbit << pos)
+        else:
+            fm_hi = fm_hi | (fbit << (pos - lo))
+        zbit = is_y | is_z
+        if pos < _PAR_LO_BITS:
+            zlo = zlo | (zbit << pos)
+        else:
+            zhi = zhi | (zbit << (pos - _PAR_LO_BITS))
+        ny = ny + is_y
+    return fm_lo, fm_hi, zlo, zhi, ny
+
+
+def _flip_gather(amps, fm_lo, fm_hi, n: int):
+    """psi[i ^ fm] for the whole (2, 2^n) state with a TRACED flip mask:
+    one row-axis take (contiguous 2^lo-element rows) + one lane-axis
+    take — the split keeps both index vectors small and the row reads
+    contiguous."""
+    lo = min(_GATHER_LO_BITS, n)
+    hi = n - lo
+    idx_lo = jax.lax.iota(jnp.uint32, 1 << lo) ^ fm_lo
+    v = amps.reshape(2, 1 << hi, 1 << lo)
+    if hi:
+        idx_hi = jax.lax.iota(jnp.uint32, 1 << hi) ^ fm_hi
+        v = jnp.take(v, idx_hi, axis=1)
+    return jnp.take(v, idx_lo, axis=2).reshape(2, -1)
+
+
+def _iexp_factor(ny, dt):
+    """(-i)^{ny} as (re, im) scalars."""
+    k = ny % 4
+    c_re = jnp.where(k == 0, 1.0, jnp.where(k == 2, -1.0, 0.0)).astype(dt)
+    c_im = jnp.where(k == 1, -1.0, jnp.where(k == 3, 1.0, 0.0)).astype(dt)
+    return c_re, c_im
+
+
+def _apply_pauli_traced(amps, codes, nq: int, offset: int, n: int,
+                        conj: bool):
+    """(P psi) with traced codes: gather + sign + (-i)^{#Y} factor
+    (conj negates the factor's imaginary part — conj(P) flips Y's
+    sign)."""
+    dt = amps.dtype
+    fm_lo, fm_hi, zlo, zhi, ny = _direct_masks(codes, nq, offset, n)
+    s = _parity_sign_dynamic(zlo, zhi, n, dt)
+    c_re, c_im = _iexp_factor(ny, dt)
+    if conj:
+        c_im = -c_im
+    pv = _flip_gather(amps, fm_lo, fm_hi, n)
+    pr = s * (c_re * pv[0] - c_im * pv[1])
+    pi = s * (c_re * pv[1] + c_im * pv[0])
+    return jnp.stack([pr, pi]), (fm_lo | fm_hi | zlo | zhi) == 0
+
+
+def _direct_rotation(amps, codes, ang, nq: int, offset: int, n: int,
+                     conj: bool):
+    """e^{-i ang/2 P} psi (or e^{-i ang/2 conj(P)} psi when ``conj``) in
+    ONE gather + combine; all-identity terms contribute only a global
+    phase the gate stream skips (the same zeroing as make_trotter_body)."""
+    dt = amps.dtype
+    pv, is_identity = _apply_pauli_traced(amps, codes, nq, offset, n, conj)
+    theta = jnp.where(is_identity, jnp.asarray(0.0, dt), ang)
+    co = jnp.cos(0.5 * theta)
+    si = jnp.sin(0.5 * theta)
+    # out = cos*psi - i sin * (P psi)
+    return jnp.stack([co * amps[0] + si * pv[1],
+                      co * amps[1] - si * pv[0]])
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "rep_qubits"),
@@ -285,46 +410,98 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
                  rep_qubits: int):
     """The whole Trotter gate stream as ONE lax.scan over a (T, nq)
     Pauli-code table + (T,) angle vector: compile cost is a single term
-    body (a basis-rotation layer, one data-driven parity phase, the
-    unrotation layer — plus bra twins for density matrices) regardless of
-    term count, replacing the unrolled per-term multiRotatePauli stream
-    whose first-call compile took minutes at config-5 scale
-    (agnostic_applyTrotterCircuit, QuEST_common.c:752-834)."""
+    body regardless of term count, replacing the unrolled per-term
+    multiRotatePauli stream whose first-call compile took minutes at
+    config-5 scale (agnostic_applyTrotterCircuit, QuEST_common.c:752-834).
+
+    The term body is the direct Pauli rotation (one split-axis gather +
+    elementwise combine; density matrices add the conjugated bra twin at
+    -theta) — ~8x the throughput of the rotate/phase/unrotate window
+    body at 24q.  Registers beyond _DIRECT_MAX_N state bits (where the
+    row-gather iota would overflow int32) and the SHARDED scan
+    (parallel.dist.trotter_scan_sharded — a traced XOR of mesh bits
+    cannot ride a static ppermute) keep the rotation-conjugation body;
+    mesh-sweep parity tests pin the two forms equal."""
     n, nq = num_qubits, rep_qubits
     dt = amps.dtype
-    body = make_trotter_body(
-        dt, nq, n == 2 * nq,
-        layer=lambda carry, mats: _product_layer(carry, mats, n),
-        parity_phase=lambda carry, theta, zlo, zhi: _parity_phase_mask(
-            carry, theta, zlo, zhi, n),
-    )
+    if n > _DIRECT_MAX_N:
+        body = make_trotter_body(
+            dt, nq, n == 2 * nq,
+            layer=lambda carry, mats: _product_layer(carry, mats, n),
+            parity_phase=lambda carry, theta, zlo, zhi: _parity_phase_mask(
+                carry, theta, zlo, zhi, n),
+        )
+        amps, _ = jax.lax.scan(body, amps, (codes_seq, angles))
+        return amps
+
+    is_density = n == 2 * nq
+
+    def body(carry, inp):
+        codes, ang = inp
+        ang = ang.astype(dt)
+        carry = _direct_rotation(carry, codes, ang, nq, 0, n, conj=False)
+        if is_density:
+            carry = _direct_rotation(carry, codes, -ang, nq, nq, n,
+                                     conj=True)
+        return carry, None
+
     amps, _ = jax.lax.scan(body, amps, (codes_seq, angles))
     return amps
 
 
-@partial(jax.jit, static_argnames=("num_qubits",))
-def expec_pauli_sum_scan(amps, codes_seq, coeffs, *, num_qubits: int):
+@partial(jax.jit, static_argnames=("num_qubits", "quad"))
+def expec_pauli_sum_scan(amps, codes_seq, coeffs, *, num_qubits: int,
+                         quad: bool = False):
     """Re <psi| sum_t c_t P_t |psi> as ONE lax.scan over the (T, n)
     Pauli-code table: per term, basis-rotate a COPY of the state so P_t
     becomes a Z-string (the multiRotatePauli trick, QuEST_common.c:424-462
     applied to expectation values), then reduce sum s(idx) |phi|^2 with the
     parity sign fused into the sum.  Compile cost is one term body
     regardless of term count — the unrolled variant took ~100 s to compile
-    at 16 terms x 24 qubits."""
+    at 16 terms x 24 qubits.
+
+    ``quad`` (prec 4): the signed per-term norm accumulates in
+    double-double (calculations.quad_sum) and the cross-term combine runs
+    a Neumaier scan over the emitted term values — the reference's
+    QuEST_PREC=4 runs this whole reduction in long double."""
+    from . import calculations as _calc
+
     n = num_qubits
     dt = amps.dtype
 
-    def signed_norm(phi, zlo, zhi):
-        s = _parity_sign_dynamic(zlo, zhi, n, dt)
-        return jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
+    if n > _DIRECT_MAX_N:
+        def signed_norm(phi, zlo, zhi):
+            s = _parity_sign_dynamic(zlo, zhi, n, dt)
+            if quad:
+                return _calc.quad_sum2(s * phi[0] * phi[0],
+                                       s * phi[1] * phi[1])
+            return jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
 
-    body = make_expec_term_value(
-        dt, n,
-        layer=lambda a, mats: _product_layer(a, mats, n),
-        signed_norm=signed_norm,
-    )(amps)
-    total, _ = jax.lax.scan(body, jnp.zeros((), dt), (codes_seq, coeffs))
-    return total
+        body = make_expec_term_value(
+            dt, n,
+            layer=lambda a, mats: _product_layer(a, mats, n),
+            signed_norm=signed_norm,
+        )(amps)
+        total, vals = jax.lax.scan(body, jnp.zeros((), dt),
+                                   (codes_seq, coeffs))
+        return _calc.neumaier_sum(vals) if quad else total
+
+    # direct form: Re <psi| c_t P_t |psi> = c_t * sum_i (psi_r pr +
+    # psi_i pi) with (pr, pi) = P psi via one split-axis gather — one
+    # state pass per term instead of a basis-rotation layer + reduce
+    def body(acc, inp):
+        codes, coeff = inp
+        pv, _ = _apply_pauli_traced(amps, codes, n, 0, n, conj=False)
+        if quad:
+            r = _calc.quad_sum2(amps[0] * pv[0], amps[1] * pv[1])
+        else:
+            r = jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
+        v = coeff.astype(dt) * r
+        return acc + v, v
+
+    total, vals = jax.lax.scan(body, jnp.zeros((), dt),
+                               (codes_seq, coeffs))
+    return _calc.neumaier_sum(vals) if quad else total
 
 
 @partial(jax.jit, static_argnames=("num_qubits", "dtype", "sharding"))

@@ -399,9 +399,9 @@ def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
     )(amps, codes_seq, angles)
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits"))
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "quad"))
 def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
-                                 num_qubits: int):
+                                 num_qubits: int, quad: bool = False):
     """Re <psi| sum_t c_t P_t |psi> on a SHARDED statevector as ONE
     shard_map(lax.scan) — the sharded form of
     ops/paulis.expec_pauli_sum_scan: per term, basis-rotate per shard
@@ -429,13 +429,29 @@ def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
         loc_lo, loc_hi, sm = _split_parity_mask(zlo, zhi, nloc, r)
         s = _paulis._parity_sign_dynamic(loc_lo, loc_hi, nloc, dt)
         s_sh = _shard_parity_sign(sm, dt)
+        if quad:
+            from ..ops import calculations as _calc
+            return s_sh * _calc.quad_sum2(s * phi[0] * phi[0],
+                                          s * phi[1] * phi[1])
         return s_sh * jnp.sum(s * (phi[0] * phi[0] + phi[1] * phi[1]))
 
     def kernel(local, codes_seq, coeffs):
+        from ..ops import calculations as _calc
         body = _paulis.make_expec_term_value(
             dt, n, layer=layer, signed_norm=signed_norm)(local)
-        tot, _ = jax.lax.scan(body, jnp.zeros((), dt), (codes_seq, coeffs))
-        return lax.psum(tot, AMP_AXIS)
+        tot, vals = jax.lax.scan(body, jnp.zeros((), dt),
+                                 (codes_seq, coeffs))
+        if not quad:
+            return lax.psum(tot, AMP_AXIS)
+        # quad: per-shard double-double partials, then ONE all-gather of
+        # the (T,) per-shard term values and a deterministic Neumaier
+        # combine over the (T, ndev) grid — a plain psum would re-lose
+        # cross-shard cancellation at f64 exactly where the reference's
+        # MPI_Allreduce of long doubles would not
+        # (QuEST_cpu_distributed.c:35-51).  The gathered payload is
+        # T*ndev scalars — not a state gather.
+        g = lax.all_gather(vals, AMP_AXIS)          # (ndev, T)
+        return _calc.neumaier_sum(g.T.reshape(-1))
 
     return shard_map(
         kernel, mesh=mesh,

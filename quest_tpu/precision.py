@@ -46,11 +46,17 @@ def set_precision(quest_prec: int) -> None:
     type, and the reference itself forbids quad on its GPU backend
     ("Quad precision unsupported on GPU", QuEST/CMakeLists.txt:69-73),
     so the TPU backend inherits exactly that restriction for storage.
-    What prec 4 DOES change: REAL_EPS tightens to the reference's 1e-14,
-    the message cap drops to 2^27 amps, and the scalar reductions where
-    extended precision is observable (calcTotalProb, inner products)
-    accumulate in double-double via error-free-transform compensation
-    (ops/calculations.py quad paths).
+    What prec 4 DOES change: REAL_EPS tightens to the reference's 1e-14
+    (validation of user matrices stays at the f64 tolerance — see
+    validation_eps), the message cap drops to 2^27 amps, and EVERY
+    scalar reduction where extended precision is observable accumulates
+    in double-double via error-free-transform compensation
+    (ops/calculations.py quad paths + the paulis expectation scans):
+    calcTotalProb, inner products, purity, fidelity, Hilbert-Schmidt
+    distance, expec-diagonal, prob-of-outcome, and the Pauli-sum
+    expectation scans (sharded included) — the reductions the reference
+    runs in long double under QuEST_PREC=4
+    (QuEST_cpu.c:861-1071,3363-3645).
     """
     if quest_prec not in (1, 2, 4):
         raise ValueError(
@@ -73,8 +79,19 @@ def complex_dtype():
 
 
 def real_eps() -> float:
-    """Validation tolerance, matching QuEST_precision.h REAL_EPS."""
+    """Reported epsilon, matching QuEST_precision.h REAL_EPS."""
     return _REAL_EPS[_state.quest_prec]
+
+
+def validation_eps() -> float:
+    """Tolerance for unitarity / CPTP / normalisation checks of
+    user-supplied matrices and scalars.  Under prec 4 this stays at the
+    f64 value (1e-13): the check arithmetic itself runs in f64 (the
+    reference's quad mode validates in long double, where 1e-14 is
+    comfortable — here a valid matrix can sit at the f64 rounding floor
+    and 1e-14 would falsely reject it; ADVICE r4).  The tightened 1e-14
+    is reserved for the compensated-reduction outputs."""
+    return _REAL_EPS[min(_state.quest_prec, 2)]
 
 
 # Reference cap on amps per MPI message / full-state host gather

@@ -1,0 +1,197 @@
+"""Config-5 decomposition (VERDICT r4 item 1): where do the bench's
+0.57-0.62 s per-iteration K-diffs go, when the drift-resistant large-K
+marginal of the trotter scan alone is ~0.106 s?
+
+Suspects, measured separately via large-K contrast ((T[Kx]-T[1x])/(K-1),
+median of reps):
+
+  A. full bench iteration through the public API (calcExpecPauliHamil,
+     which float()s the result -> one relay round-trip PER iteration,
+     + applyTrotterCircuit, which rebuilds + re-uploads the (32,24)
+     codes table and (32,) angles host->device PER call)
+  B. applyTrotterCircuit alone (API, host schedule + H2D per call)
+  C. calcExpecPauliHamil alone (API, float() sync per call)
+  D. device truth: ONE jitted [expec + trotter] program per iteration,
+     value kept on device, single fetch at the end
+  E. trotter_scan jitted entry alone at the bench schedule shape (32,24)
+  F. expec_pauli_sum_scan jitted entry alone at (16,24)
+  G. the bare relay fetch: float() of an already-computed scalar
+
+If A >> D + G, the bench form (per-iteration sync + per-call H2D) is the
+artifact, not kernel time.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("devices:", jax.devices(), flush=True)
+
+    import quest_tpu as qt
+    from quest_tpu.api_ops import _trotter_schedule
+    from quest_tpu.ops import paulis as P
+
+    env = qt.createQuESTEnv()
+    n, terms = 24, 16
+    rng = np.random.default_rng(7)
+    hamil = qt.createPauliHamil(n, terms)
+    qt.initPauliHamil(hamil, rng.standard_normal(terms),
+                      rng.integers(0, 4, size=(terms, n)))
+
+    res = {"n": n, "terms": terms}
+    KHI = 8
+
+    def marginal(label, run_k, reps=5, khi=KHI):
+        run_k(1)
+        run_k(khi)
+        ds = []
+        for _ in range(reps):
+            t1 = run_k(1)
+            tk = run_k(khi)
+            ds.append((tk - t1) / (khi - 1))
+        res[label] = {"median": round(statistics.median(ds), 5),
+                      "min": round(min(ds), 5),
+                      "max": round(max(ds), 5)}
+        print(label, res[label], flush=True)
+
+    # --- A: full bench iteration (public API, float per iteration) ---
+    def run_bench(k):
+        psi = qt.createQureg(n, env)
+        qt.initPlusState(psi)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            qt.calcExpecPauliHamil(psi, hamil)
+            qt.applyTrotterCircuit(psi, hamil, 0.1, 2, 1)
+        return time.perf_counter() - t0
+
+    marginal("A_api_full_iter", run_bench)
+
+    # --- B: applyTrotterCircuit alone ---
+    def run_trotter_api(k):
+        psi = qt.createQureg(n, env)
+        qt.initPlusState(psi)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            qt.applyTrotterCircuit(psi, hamil, 0.1, 2, 1)
+        qt.calcTotalProb(psi)
+        return time.perf_counter() - t0
+
+    marginal("B_api_trotter_only", run_trotter_api)
+
+    # --- C: calcExpecPauliHamil alone (state fixed) ---
+    def run_expec_api(k):
+        psi = qt.createQureg(n, env)
+        qt.initPlusState(psi)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            qt.calcExpecPauliHamil(psi, hamil)
+        return time.perf_counter() - t0
+
+    marginal("C_api_expec_only", run_expec_api)
+
+    # --- D: device truth, one jitted [expec+trotter] per iter, no sync ---
+    seq = _trotter_schedule(terms, 0.1, 2, 1)
+    t_idx = np.asarray([t for t, _ in seq])
+    facs = np.asarray([f for _, f in seq])
+    codes_tr = jnp.asarray(
+        np.asarray(hamil.pauli_codes)[t_idx].astype(np.int32))
+    angles_tr = jnp.asarray(
+        2.0 * facs * np.asarray(hamil.term_coeffs, np.float64)[t_idx])
+    codes_ex = jnp.asarray(np.asarray(hamil.pauli_codes, np.int32))
+    coeffs_ex = jnp.asarray(np.asarray(hamil.term_coeffs, np.float64))
+    print("trotter schedule len:", len(seq), flush=True)
+    res["schedule_len"] = len(seq)
+
+    from quest_tpu.ops import kernels
+
+    def state():
+        a = kernels.init_plus_state(1 << n, np.float32)
+        return jnp.asarray(a)
+
+    def run_device(k):
+        a = state()
+        es = []
+        t0 = time.perf_counter()
+        for _ in range(k):
+            es.append(P.expec_pauli_sum_scan(a, codes_ex, coeffs_ex,
+                                             num_qubits=n))
+            a = P.trotter_scan(a, codes_tr, angles_tr,
+                               num_qubits=n, rep_qubits=n)
+        float(es[-1])
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("D_device_expec_plus_trotter", run_device)
+
+    # --- E: trotter_scan alone, bench schedule shape ---
+    def run_tscan(k):
+        a = state()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = P.trotter_scan(a, codes_tr, angles_tr,
+                               num_qubits=n, rep_qubits=n)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("E_trotter_scan_sched32", run_tscan)
+
+    # --- F: expec scan alone ---
+    def run_escan(k):
+        a = state()
+        e = None
+        t0 = time.perf_counter()
+        for _ in range(k):
+            e = P.expec_pauli_sum_scan(a, codes_ex, coeffs_ex, num_qubits=n)
+        float(e)
+        return time.perf_counter() - t0
+
+    marginal("F_expec_scan_T16", run_escan)
+
+    # --- G: bare relay fetch of a ready scalar ---
+    s = jnp.sum(state()[0, :4])
+    s.block_until_ready()
+    fs = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(s)
+        fs.append(time.perf_counter() - t0)
+    res["G_ready_scalar_fetch"] = {
+        "median": round(statistics.median(fs), 5), "min": round(min(fs), 5)}
+    print("G_ready_scalar_fetch", res["G_ready_scalar_fetch"], flush=True)
+
+    # host-side schedule+convert cost in applyTrotterCircuit (no dispatch)
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        seq2 = _trotter_schedule(terms, 0.1, 2, 1)
+        ti = np.asarray([t for t, _ in seq2])
+        fc = np.asarray([f for _, f in seq2])
+        cs = np.asarray(hamil.pauli_codes)[ti].astype(np.int32)
+        an = 2.0 * fc * np.asarray(hamil.term_coeffs, np.float64)[ti]
+        jnp.asarray(cs).block_until_ready()
+        jnp.asarray(an).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    res["H_host_schedule_plus_h2d"] = {
+        "median": round(statistics.median(ts), 5), "min": round(min(ts), 5)}
+    print("H_host_schedule_plus_h2d", res["H_host_schedule_plus_h2d"],
+          flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_config5_decomp_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

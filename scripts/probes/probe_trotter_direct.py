@@ -1,0 +1,234 @@
+"""Probe an alternative Trotter-term formulation at 24q (VERDICT r5
+item 1): instead of rotate-layer -> parity-phase -> unrotate-layer
+(~6 window passes + 2 phases per term), apply the rotation DIRECTLY:
+
+    e^{-i th/2 P} psi = cos(th/2) psi - i sin(th/2) (P psi)
+    (P psi)[i] = c * s[i] * psi[i ^ flipmask]      (P^2 = I)
+
+with s the +/-1 parity sign of the Z/Y mask and c = (-i)^{#Y}; the whole
+term is ONE elementwise combine reading psi at i and i^flip — if the
+dynamic-flip permutation is cheap.  Candidate flip implementations:
+
+  a. flat dynamic gather  psi[iota ^ fm]          (XLA gather at 2^24)
+  b. row/col split: (hi,lo) view, gather rows by iota_hi^fm_hi and
+     lanes by iota_lo^fm_lo (two small index vectors, one take per axis)
+  c. bit-serial: 24x where(bit_k(fm), flip_axis_k(psi), psi)
+
+Also measured: per-pass cost of the existing window layer at 24q (is it
+HBM-bound or overhead-bound at this size?), and a plain-XLA einsum layer
+variant.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("devices:", jax.devices(), flush=True)
+
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+    KHI = 8
+
+    def state():
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jnp.asarray(a)
+
+    def marginal(label, run_k, reps=5, khi=KHI):
+        run_k(1)
+        run_k(khi)
+        ds = []
+        for _ in range(reps):
+            t1 = run_k(1)
+            tk = run_k(khi)
+            ds.append((tk - t1) / (khi - 1))
+        res[label] = {"median": round(statistics.median(ds), 5),
+                      "min": round(min(ds), 5)}
+        print(label, res[label], flush=True)
+
+    T = 16
+    codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+    angles = jnp.asarray(rng.normal(size=T))
+
+    # ---- masks from codes (traced): flip = X|Y bits, par = Z|Y bits ----
+    def term_masks(cd):
+        fm = jnp.uint32(0)
+        zlo = jnp.uint32(0)
+        ny = jnp.uint32(0)
+        for q in range(n):
+            is_x = (cd[q] == 1).astype(jnp.uint32)
+            is_y = (cd[q] == 2).astype(jnp.uint32)
+            is_z = (cd[q] == 3).astype(jnp.uint32)
+            fm = fm | ((is_x | is_y) << q)
+            zlo = zlo | ((is_y | is_z) << q)
+            ny = ny + is_y
+        return fm, zlo, ny
+
+    LO = 12
+    HI = n - LO
+
+    def direct_term_rowcol(a, cd, ang):
+        """(hi, lo) split: flip rows via one hi-index take, lanes via one
+        lo-index take."""
+        fm, zm, ny = term_masks(cd)
+        dt = a.dtype
+        s = P._parity_sign_dynamic(zm, jnp.uint32(0), n, dt)
+        # c = (-i)^{ny}: rotate (re,im) by ny*(-90deg)
+        k = ny % 4
+        c_re = jnp.where(k == 0, 1.0, jnp.where(k == 2, -1.0, 0.0)).astype(dt)
+        c_im = jnp.where(k == 1, -1.0, jnp.where(k == 3, 1.0, 0.0)).astype(dt)
+        idx_lo = jax.lax.iota(jnp.uint32, 1 << LO) ^ (fm & ((1 << LO) - 1))
+        idx_hi = jax.lax.iota(jnp.uint32, 1 << HI) ^ (fm >> LO)
+        v = a.reshape(2, 1 << HI, 1 << LO)
+        pv = jnp.take(jnp.take(v, idx_hi, axis=1), idx_lo, axis=2)
+        pv = pv.reshape(2, -1)
+        # P psi = (c_re + i c_im) * s * pv  (elementwise complex)
+        pr = s * (c_re * pv[0] - c_im * pv[1])
+        pi = s * (c_re * pv[1] + c_im * pv[0])
+        co = jnp.cos(0.5 * ang).astype(dt)
+        si = jnp.sin(0.5 * ang).astype(dt)
+        # out = cos*psi - i sin * (P psi)
+        return jnp.stack([co * a[0] + si * pi, co * a[1] - si * pr])
+
+    def direct_term_flat(a, cd, ang):
+        fm, zm, ny = term_masks(cd)
+        dt = a.dtype
+        s = P._parity_sign_dynamic(zm, jnp.uint32(0), n, dt)
+        k = ny % 4
+        c_re = jnp.where(k == 0, 1.0, jnp.where(k == 2, -1.0, 0.0)).astype(dt)
+        c_im = jnp.where(k == 1, -1.0, jnp.where(k == 3, 1.0, 0.0)).astype(dt)
+        idx = jax.lax.iota(jnp.uint32, 1 << n) ^ fm
+        pv = jnp.take(a, idx, axis=1)
+        pr = s * (c_re * pv[0] - c_im * pv[1])
+        pi = s * (c_re * pv[1] + c_im * pv[0])
+        co = jnp.cos(0.5 * ang).astype(dt)
+        si = jnp.sin(0.5 * ang).astype(dt)
+        return jnp.stack([co * a[0] + si * pi, co * a[1] - si * pr])
+
+    def direct_term_bitserial(a, cd, ang):
+        fm, zm, ny = term_masks(cd)
+        dt = a.dtype
+        s = P._parity_sign_dynamic(zm, jnp.uint32(0), n, dt)
+        k = ny % 4
+        c_re = jnp.where(k == 0, 1.0, jnp.where(k == 2, -1.0, 0.0)).astype(dt)
+        c_im = jnp.where(k == 1, -1.0, jnp.where(k == 3, 1.0, 0.0)).astype(dt)
+        pv = a
+        for q in range(n):
+            flipped = jax.lax.rev(
+                pv.reshape(2, 1 << (n - 1 - q), 2, 1 << q), (2,)
+            ).reshape(2, -1)
+            pv = jnp.where((fm >> q) & 1, flipped, pv)
+        pr = s * (c_re * pv[0] - c_im * pv[1])
+        pi = s * (c_re * pv[1] + c_im * pv[0])
+        co = jnp.cos(0.5 * ang).astype(dt)
+        si = jnp.sin(0.5 * ang).astype(dt)
+        return jnp.stack([co * a[0] + si * pi, co * a[1] - si * pr])
+
+    def scan_of(term_fn):
+        @jax.jit
+        def prog(a, cds, angs):
+            def body(carry, inp):
+                cd, ang = inp
+                return term_fn(carry, cd, ang.astype(carry.dtype)), None
+            out, _ = jax.lax.scan(body, a, (cds, angs))
+            return out
+        return prog
+
+    # correctness vs trotter_scan first
+    a0 = state()
+    ref = P.trotter_scan(jnp.array(a0), codes, angles,
+                         num_qubits=n, rep_qubits=n)
+    for name, fn in [("rowcol", direct_term_rowcol),
+                     ("flat", direct_term_flat)]:
+        got = scan_of(fn)(jnp.array(a0), codes, angles)
+        md = float(jnp.max(jnp.abs(got - ref)))
+        res[f"maxdiff_{name}"] = md
+        print(f"maxdiff_{name}: {md:.2e}", flush=True)
+
+    # bitserial dropped: its 24 where(flip)-chained full-state
+    # intermediates exceed HBM at compile (16.1G > 15.75G)
+    for name, fn in [("rowcol", direct_term_rowcol),
+                     ("flat", direct_term_flat)]:
+        prog = scan_of(fn)
+
+        def run_k(k, prog=prog):
+            a = state()
+            t0 = time.perf_counter()
+            for _ in range(k):
+                a = prog(a, codes, angles)
+            float(jnp.sum(a[0, :1]))
+            return time.perf_counter() - t0
+
+        marginal(f"direct_{name}_T16", run_k)
+
+    # ---- reference point: existing scan, same codes ----
+    def run_scan(k):
+        a = state()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = P.trotter_scan(a, codes, angles, num_qubits=n, rep_qubits=n)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("window_scan_T16", run_scan)
+
+    # ---- plain-XLA einsum layer (no pallas) for comparison ----
+    mats = jnp.asarray(rng.standard_normal((n, 2, 2, 2)).astype(np.float32))
+
+    def einsum_layer(a, m):
+        # contract qubits in 4 groups of 6: build 64x64 SoA mats by kron
+        v = a.reshape((2,) + (64,) * 4)
+        for g in range(4):
+            acc_r = jnp.asarray(np.eye(1, dtype=np.float32))
+            acc_i = jnp.zeros((1, 1), jnp.float32)
+            for q in range(6 * g, 6 * g + 6):
+                mr, mi = m[q, 0], m[q, 1]
+                acc_r, acc_i = (jnp.kron(mr, acc_r) - jnp.kron(mi, acc_i),
+                                jnp.kron(mr, acc_i) + jnp.kron(mi, acc_r))
+            ax = 4 - g
+            vr = jnp.moveaxis(v, ax, -1)
+            rr = jnp.einsum("ij,...j->...i", acc_r, vr[0])
+            ri = jnp.einsum("ij,...j->...i", acc_i, vr[0])
+            ir = jnp.einsum("ij,...j->...i", acc_r, vr[1])
+            ii = jnp.einsum("ij,...j->...i", acc_i, vr[1])
+            v = jnp.moveaxis(jnp.stack([rr - ii, ri + ir]), -1, ax)
+        return v.reshape(2, -1)
+
+    @partial(jax.jit, static_argnames="k")
+    def einsum_prog(a, m, k):
+        for _ in range(k):
+            a = einsum_layer(a, m)
+        return a
+
+    def run_einsum(k):
+        a = state()
+        t0 = time.perf_counter()
+        a = einsum_prog(a, mats, k)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    marginal("einsum_layer_per_pass", run_einsum)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_trotter_direct_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,336 @@
+"""Mesh-size sweep for the explicit distributed layer (VERDICT r4 item 4).
+
+The reference runs its whole suite under ``mpirun -np {2,4,8,16}``
+(/root/reference/examples/README.md:438-451); the suite otherwise pins
+ONE mesh size (8 virtual devices, r=3, tests/conftest.py).  Every
+shard-count-dependent branch gets exercised here at r in {1, 2, 3}
+(2/4/8 devices, via createQuESTEnv(num_devices=...) truncating the
+8-device virtual backend) with BOTH oracle parity and pinned HLO
+collective counts, plus the boundary cases the single mesh never hits:
+
+- nloc = r (the smallest register that still spans the mesh, n = 2r):
+  _split_parity_mask's three branches and the 1q exchange at minimal
+  local width;
+- plan_relocalization free-pool exhaustion (more sharded targets than
+  free local qubits) and the barely-enough case;
+- a 16-device (r=4) smoke in a subprocess (the virtual backend holds 8
+  devices per process), driving gate/trotter/expec/measure end-to-end.
+
+The full-register fused-QFT guard ``nsv - r >= r`` (api_ops._try_fused
+qft routing) can only go false at r >= 8 (WINDOW=14 forces nsv >= 14),
+i.e. a 256-device mesh — its false branch is exercised structurally via
+fused_qft_runs_sharded below and the guard arithmetic asserted directly.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracle
+import quest_tpu as qt
+from quest_tpu.ops import paulis as OPS_P
+from quest_tpu.parallel import dist as PAR
+
+from test_distributed_hlo import collective_ops
+
+MESH_SIZES = [2, 4, 8]
+
+
+@pytest.fixture(scope="module", params=MESH_SIZES)
+def swept_env(request):
+    if len(jax.devices()) < request.param:
+        pytest.skip(f"needs {request.param} virtual devices")
+    return qt.createQuESTEnv(num_devices=request.param)
+
+
+def _r(env):
+    return PAR.num_shard_bits(env.mesh)
+
+
+def _sharded(env, arr):
+    return jax.device_put(jnp.asarray(arr), env.amp_sharding())
+
+
+def _rand_soa(n, seed):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((2, 1 << n))
+    a /= np.sqrt((a ** 2).sum())
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Scan composites: oracle parity + pinned collectives at every r
+# ---------------------------------------------------------------------------
+
+
+class TestTrotterScanSweep:
+    def test_parity_vs_unsharded(self, swept_env):
+        n = 8
+        r = _r(swept_env)
+        rng = np.random.default_rng(100 + r)
+        a = _rand_soa(n, 100 + r)
+        codes = jnp.asarray(rng.integers(0, 4, size=(5, n)), jnp.int32)
+        angles = jnp.asarray(rng.normal(size=5))
+        want = np.asarray(OPS_P.trotter_scan(
+            jnp.asarray(a), codes, angles, num_qubits=n, rep_qubits=n))
+        got = np.asarray(PAR.trotter_scan_sharded(
+            _sharded(swept_env, a), codes, angles, mesh=swept_env.mesh,
+            num_qubits=n, rep_qubits=n))
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_parity_at_nloc_equals_r(self, swept_env):
+        """n = 2r: every local qubit is matched by a mesh bit — the
+        smallest register the explicit layer accepts."""
+        r = _r(swept_env)
+        n = 2 * r
+        rng = np.random.default_rng(200 + r)
+        a = _rand_soa(n, 200 + r)
+        codes = jnp.asarray(rng.integers(0, 4, size=(4, n)), jnp.int32)
+        angles = jnp.asarray(rng.normal(size=4))
+        want = np.asarray(OPS_P.trotter_scan(
+            jnp.asarray(a), codes, angles, num_qubits=n, rep_qubits=n))
+        got = np.asarray(PAR.trotter_scan_sharded(
+            _sharded(swept_env, a), codes, angles, mesh=swept_env.mesh,
+            num_qubits=n, rep_qubits=n))
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_hlo_two_permutes_per_sharded_qubit(self, swept_env):
+        n = 8
+        r = _r(swept_env)
+        amps = _sharded(swept_env, _rand_soa(n, 300 + r))
+        codes = jnp.asarray(np.random.default_rng(0).integers(
+            0, 4, size=(3, n)), jnp.int32)
+        angles = jnp.asarray(np.linspace(0.1, 0.3, 3))
+
+        def f(a):
+            return PAR.trotter_scan_sharded(
+                a, codes, angles, mesh=swept_env.mesh, num_qubits=n,
+                rep_qubits=n)
+
+        assert collective_ops(f, amps, donate=True) == {
+            "collective-permute": 2 * r}
+
+
+class TestExpecScanSweep:
+    def test_parity_vs_unsharded(self, swept_env):
+        n = 8
+        r = _r(swept_env)
+        rng = np.random.default_rng(400 + r)
+        a = _rand_soa(n, 400 + r)
+        codes = jnp.asarray(rng.integers(0, 4, size=(4, n)), jnp.int32)
+        coeffs = jnp.asarray(rng.normal(size=4))
+        want = float(OPS_P.expec_pauli_sum_scan(
+            jnp.asarray(a), codes, coeffs, num_qubits=n))
+        got = float(PAR.expec_pauli_sum_scan_sharded(
+            _sharded(swept_env, a), codes, coeffs, mesh=swept_env.mesh,
+            num_qubits=n))
+        assert abs(got - want) < 1e-12
+
+    def test_hlo_r_permutes_one_allreduce(self, swept_env):
+        n = 8
+        r = _r(swept_env)
+        amps = _sharded(swept_env, _rand_soa(n, 500 + r))
+        codes = jnp.asarray(np.random.default_rng(1).integers(
+            0, 4, size=(3, n)), jnp.int32)
+        coeffs = jnp.asarray(np.linspace(1.0, 2.0, 3))
+
+        def f(a):
+            return PAR.expec_pauli_sum_scan_sharded(
+                a, codes, coeffs, mesh=swept_env.mesh, num_qubits=n)
+
+        hist = collective_ops(f, amps)
+        permutes = hist.get("collective-permute", 0)
+        reduces = (hist.get("all-reduce", 0)
+                   + hist.get("all-reduce-start", 0))
+        assert permutes == r and reduces == 1, hist
+        assert set(hist) <= {"collective-permute", "all-reduce",
+                             "all-reduce-start"}, hist
+
+
+# ---------------------------------------------------------------------------
+# API end-to-end per mesh size: gates, channels, QFT, measurement
+# ---------------------------------------------------------------------------
+
+
+class TestApiSweep:
+    def test_gates_reductions_measure(self, swept_env):
+        """Sharded-target 1q gate, sharded control, 2q relocalization,
+        reductions and fused measurement — through the public API at
+        every mesh size."""
+        n = 8
+        q = qt.createQureg(n, swept_env)
+        for t in range(n):
+            qt.hadamard(q, t)
+        qt.controlledNot(q, n - 1, 0)
+        rng = np.random.default_rng(7)
+        m = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+        u, _ = np.linalg.qr(m)
+        qt.twoQubitUnitary(q, 2, n - 1, u)
+        assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+        p0 = qt.calcProbOfOutcome(q, n - 1, 0)
+        assert 0.0 <= p0 <= 1.0 + 1e-12
+        outcome, _ = qt.measureWithStats(q, n - 1)
+        assert outcome in (0, 1)
+        assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+
+    def test_density_channels(self, swept_env):
+        nq = 4
+        rho = qt.createDensityQureg(nq, swept_env)
+        qt.hadamard(rho, 0)
+        qt.mixDepolarising(rho, nq - 1, 0.2)
+        qt.mixDamping(rho, nq - 1, 0.1)
+        qt.mixDephasing(rho, 0, 0.05)
+        assert abs(qt.calcTotalProb(rho) - 1.0) < 1e-10
+
+    def test_full_qft_vs_dft_oracle(self, swept_env):
+        """applyFullQFT at window size on every mesh: r in {1,2,3} all
+        satisfy the nsv - r >= r guard (14 - 3 = 11 >= 3), so the
+        all-mesh fused kernel runs; parity against the dense DFT."""
+        n = 14
+        rng = np.random.default_rng(60 + _r(swept_env))
+        vec = oracle.random_state(n, rng)
+        q = qt.createQureg(n, swept_env)
+        oracle.set_qureg_from_array(qt, q, vec)
+        qt.applyFullQFT(q)
+        want = oracle.dft_matrix(n) @ vec
+        np.testing.assert_allclose(oracle.state_from_qureg(q), want,
+                                   atol=1e-10)
+
+    def test_partial_qft_mesh_run(self, swept_env):
+        """A run reaching the mesh bits routes fused_qft_runs_sharded's
+        ppermute layers + mixed reversal at every r."""
+        n = 14
+        r = _r(swept_env)
+        start, count = 7, n - 7
+        rng = np.random.default_rng(70 + r)
+        vec = oracle.random_state(n, rng)
+        q = qt.createQureg(n, swept_env)
+        oracle.set_qureg_from_array(qt, q, vec)
+        qt.applyQFT(q, list(range(start, start + count)))
+        D = oracle.dft_matrix(count)
+        want = oracle.full_operator(
+            n, list(range(start, start + count)), D) @ vec
+        np.testing.assert_allclose(oracle.state_from_qureg(q), want,
+                                   atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# Boundary cases
+# ---------------------------------------------------------------------------
+
+
+def test_qft_guard_arithmetic():
+    """The full-register fused-QFT guard nsv - r >= r: with WINDOW=14
+    forcing nsv >= 14 the false branch needs r >= 7 (a 128-device mesh)
+    — assert the arithmetic so a future WINDOW change that makes the
+    edge reachable shows up here."""
+    from quest_tpu import circuit as CIRC
+
+    assert CIRC.WINDOW == 14
+    for r in (1, 2, 3, 4, 7):
+        assert CIRC.WINDOW - r >= r  # guard true at every testable r
+    assert CIRC.WINDOW - 8 < 8       # first false r: a 256-device mesh
+
+
+class TestRelocalizationPool:
+    def test_exhaustion_returns_none(self):
+        """More sharded targets than free local qubits: (None, None) —
+        the caller falls back (the reference rejects such ops outright,
+        QuEST_validation.c:469-471)."""
+        swaps, new_t = PAR.plan_relocalization(
+            6, 2, targets=(0, 1, 4, 5))
+        assert swaps is None and new_t is None
+
+    def test_controls_shrink_the_pool(self):
+        swaps, new_t = PAR.plan_relocalization(
+            6, 2, targets=(4, 5), controls=(0,))
+        assert swaps is None and new_t is None
+
+    def test_barely_enough(self):
+        swaps, new_t = PAR.plan_relocalization(
+            6, 2, targets=(4, 5))
+        assert swaps == ((0, 4), (1, 5)) and new_t == (0, 1)
+
+    def test_end_to_end_fallback_still_correct(self, env):
+        """A 3q unitary on a 2-local-qubit register (nloc < #targets
+        after exclusion): the op still completes correctly through the
+        fallback path on the virtual mesh."""
+        if env.num_devices < 8:
+            pytest.skip("needs the 8-device mesh")
+        n = 5  # nloc = 2 on 8 devices
+        rng = np.random.default_rng(81)
+        vec = oracle.random_state(n, rng)
+        q = qt.createQureg(n, env)
+        oracle.set_qureg_from_array(qt, q, vec)
+        m = rng.standard_normal((8, 8)) + 1j * rng.standard_normal((8, 8))
+        u, _ = np.linalg.qr(m)
+        qt.multiQubitUnitary(q, [2, 3, 4], u)
+        want = oracle.full_operator(n, [2, 3, 4], u) @ vec
+        np.testing.assert_allclose(oracle.state_from_qureg(q), want,
+                                   atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# 16-device smoke (subprocess: the in-process backend holds 8 devices)
+# ---------------------------------------------------------------------------
+
+_SMOKE_16 = r"""
+import sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, jax.numpy as jnp
+import quest_tpu as qt
+from quest_tpu.ops import paulis as OPS_P
+from quest_tpu.parallel import dist as PAR
+
+qt.set_precision(2)
+env = qt.createQuESTEnv()
+assert env.num_ranks == 16, env.num_ranks
+n = 8
+rng = np.random.default_rng(0)
+a = rng.standard_normal((2, 1 << n)); a /= np.sqrt((a**2).sum())
+codes = jnp.asarray(rng.integers(0, 4, size=(3, n)), jnp.int32)
+angles = jnp.asarray(rng.normal(size=3))
+want = np.asarray(OPS_P.trotter_scan(jnp.asarray(a), codes, angles,
+                                     num_qubits=n, rep_qubits=n))
+sh = jax.device_put(jnp.asarray(a), env.amp_sharding())
+got = np.asarray(PAR.trotter_scan_sharded(
+    sh, codes, angles, mesh=env.mesh, num_qubits=n, rep_qubits=n))
+np.testing.assert_allclose(got, want, atol=1e-12)
+ew = float(OPS_P.expec_pauli_sum_scan(jnp.asarray(a), codes,
+                                      angles, num_qubits=n))
+eg = float(PAR.expec_pauli_sum_scan_sharded(
+    jax.device_put(jnp.asarray(a), env.amp_sharding()), codes, angles,
+    mesh=env.mesh, num_qubits=n))
+assert abs(ew - eg) < 1e-12, (ew, eg)
+q = qt.createQureg(n, env)
+for t in range(n):
+    qt.hadamard(q, t)
+qt.controlledNot(q, n - 1, 0)
+assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+o, _ = qt.measureWithStats(q, n - 1)
+assert o in (0, 1)
+assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+print("SMOKE16 OK r=4")
+"""
+
+
+def test_sixteen_device_smoke():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env.pop("JAX_PLATFORMS", None)
+    code = _SMOKE_16.format(repo=repo, tests=tests)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SMOKE16 OK r=4" in proc.stdout

@@ -63,6 +63,185 @@ def test_quad_total_prob_and_inner_product(env, quad):
     assert abs(ip - np.vdot(vec, vec2)) < 1e-13
 
 
+_A = 2.0 ** 53  # block magnitude: ulp(256*_A) = 512, so unit terms
+                # vanish from a plain f64 accumulator mid-cancellation
+
+
+def _cancel_vec():
+    """(2, 1024) SoA pattern [+A x256][0][+1.0 x256][-A x256]: any
+    deterministic plain-f64 reduce loses the unit block while the
+    accumulator sits at 256*A (verified: the plain kernels return 0.0);
+    the 256-aligned quad block partials + Neumaier combine keep it."""
+    v = np.zeros((2, 1024))
+    v[0, 0:256] = _A
+    v[0, 512:768] = 1.0
+    v[0, 768:1024] = _A
+    return v
+
+
+def _assert_plain_loses(plain, true_val):
+    """The constructions are built so today's XLA reduce demonstrably
+    loses them at f64; if a future backend starts compensating sums the
+    demonstration (not the quad feature) becomes moot — skip with a
+    note rather than failing CI on a backend-numerics improvement."""
+    if abs(plain - true_val) <= 100.0:
+        pytest.skip("XLA's plain f64 reduce now survives this "
+                    "construction; the quad path remains verified above")
+
+
+def test_quad_expec_pauli_sum_cross_block_cancellation(env, quad):
+    """Z on qubit 8 signs the [768,1024) block negative: true value 256,
+    plain f64 scan returns 0 (VERDICT r4 item 5: the expectation scans
+    accumulate double-double at prec 4)."""
+    from quest_tpu.ops import paulis as P
+
+    n = 10
+    amps = jnp.asarray(_cancel_vec())
+    codes = np.zeros((1, n), np.int32)
+    codes[0, 8] = 3
+    plain = float(P.expec_pauli_sum_scan(
+        amps, jnp.asarray(codes), jnp.asarray(np.ones(1)), num_qubits=n))
+    quad_v = float(P.expec_pauli_sum_scan(
+        amps, jnp.asarray(codes), jnp.asarray(np.ones(1)), num_qubits=n,
+        quad=True))
+    assert quad_v == pytest.approx(256.0, abs=1e-9)
+    _assert_plain_loses(plain, 256.0)
+
+
+def test_quad_expec_pauli_api_routes_quad(env, quad):
+    """The public calcExpecPauliSum at prec 4 survives the construction
+    the plain path loses."""
+    n = 10
+    q = qt.createQureg(n, env)
+    v = _cancel_vec()
+    qt.setAmps(q, 0, v[0], v[1], 1 << n)
+    got = qt.calcExpecPauliSum(
+        q, [0] * 8 + [3] + [0] * (n - 9), [1.0])
+    assert got == pytest.approx(256.0, abs=1e-9)
+
+
+def test_quad_fidelity_cross_block_cancellation(env, quad):
+    """<psi|rho|psi> with rho columns [+A|0|+1|-A] and psi = 1...1: true
+    256; the plain matmul+reduce kernel returns 0."""
+    n = 5
+    dim = 1 << n
+    w = np.zeros((dim, dim))
+    w[0:8, :] = _A
+    w[16:24, :] = 1.0
+    w[24:32, :] = -_A
+    rho = qt.createDensityQureg(n, env)
+    qt.setDensityAmps(rho, w.reshape(-1), np.zeros(dim * dim))
+    psi = qt.createQureg(n, env)
+    qt.setAmps(psi, 0, np.ones(dim), np.zeros(dim), dim)
+    from quest_tpu.ops import calculations as CC
+
+    plain = float(CC.calc_fidelity_density(rho.amps, psi.amps,
+                                           num_qubits=n))
+    assert qt.calcFidelity(rho, psi) == pytest.approx(256.0, abs=1e-9)
+    _assert_plain_loses(plain, 256.0)
+
+
+def test_quad_density_inner_product_cancellation(env, quad):
+    n = 5
+    dim2 = 1 << (2 * n)
+    r1 = np.zeros(dim2)
+    r2 = np.zeros(dim2)
+    r1[0:256] = 1.0
+    r2[0:256] = _A
+    r1[512:768] = 1.0
+    r2[512:768] = 1.0
+    r1[768:1024] = -1.0
+    r2[768:1024] = _A
+    a = qt.createDensityQureg(n, env)
+    b = qt.createDensityQureg(n, env)
+    qt.setDensityAmps(a, r1, np.zeros(dim2))
+    qt.setDensityAmps(b, r2, np.zeros(dim2))
+    from quest_tpu.ops import calculations as CC
+
+    plain = float(CC.calc_density_inner_product(a.amps, b.amps))
+    assert qt.calcDensityInnerProduct(a, b) == pytest.approx(256.0,
+                                                            abs=1e-9)
+    _assert_plain_loses(plain, 256.0)
+
+
+def test_quad_expec_diagonal_cancellation(env, quad):
+    """calcExpecDiagonalOp at prec 4: d = (-1)^{bit 8} against the
+    cancellation state (plain returns 0, true 256)."""
+    n = 10
+    q = qt.createQureg(n, env)
+    v = _cancel_vec()
+    qt.setAmps(q, 0, np.sqrt(np.abs(v[0])) * np.sign(v[0]),
+               np.zeros(1 << n), 1 << n)
+    # |amp|^2 reproduces the magnitude pattern; signs live in d
+    d = qt.createDiagonalOp(n, env)
+    d_re = 1.0 - 2.0 * (((np.arange(1 << n) >> 8) & 1).astype(float))
+    qt.initDiagonalOp(d, d_re, np.zeros(1 << n))
+    got = qt.calcExpecDiagonalOp(q, d)
+    assert got.real == pytest.approx(256.0, abs=1e-9)
+
+
+def test_quad_nonneg_reductions_route_and_agree(env, quad):
+    """Purity / prob-of-outcome / Hilbert-Schmidt are non-negative sums
+    (condition number 1 — no cancellation to construct), so the quad
+    variants are checked for routing + agreement with the dense oracle."""
+    rng = np.random.default_rng(11)
+    n = 5
+    dim = 1 << n
+    m = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+    rho_m = m @ m.conj().T
+    rho_m /= np.trace(rho_m).real
+    a = qt.createDensityQureg(n, env)
+    qt.setDensityAmps(a, rho_m.T.reshape(-1).real,
+                      rho_m.T.reshape(-1).imag)
+    assert qt.calcPurity(a) == pytest.approx(
+        float(np.sum(np.abs(rho_m) ** 2)), rel=1e-12)
+    b = qt.createDensityQureg(n, env)
+    m2 = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+    rho2 = m2 @ m2.conj().T
+    rho2 /= np.trace(rho2).real
+    qt.setDensityAmps(b, rho2.T.reshape(-1).real,
+                      rho2.T.reshape(-1).imag)
+    assert qt.calcHilbertSchmidtDistance(a, b) == pytest.approx(
+        float(np.sqrt(np.sum(np.abs(rho_m - rho2) ** 2))), rel=1e-12)
+    vec = rng.standard_normal(dim) + 1j * rng.standard_normal(dim)
+    vec /= np.linalg.norm(vec)
+    q = qt.createQureg(n, env)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    p0 = float(np.sum(np.abs(vec[::2]) ** 2))  # qubit 0 = 0
+    assert qt.calcProbOfOutcome(q, 0, 0) == pytest.approx(p0, rel=1e-12)
+
+
+def test_quad_expec_scan_sharded_parity(env, quad):
+    """The sharded expec scan at prec 4 (per-shard double-double
+    partials, then ONE all-gather of the (T,) per-shard term values and
+    a deterministic Neumaier combine — a plain psum would re-lose
+    cross-shard cancellation at f64) matches the oracle on a
+    mesh-spanning register — the one-kernel-set contract holds at
+    quad too."""
+    n = 10
+    rng = np.random.default_rng(5)
+    vec = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    vec /= np.linalg.norm(vec)
+    q = qt.createQureg(n, env)
+    qt.initStateFromAmps(q, vec.real, vec.imag)
+    h = qt.createPauliHamil(n, 3)
+    codes = rng.integers(0, 4, size=(3, n))
+    coeffs = rng.standard_normal(3)
+    qt.initPauliHamil(h, coeffs, codes)
+    got = qt.calcExpecPauliHamil(q, h)
+    # dense oracle
+    import functools
+    P2 = [np.eye(2), np.array([[0, 1], [1, 0]]),
+          np.array([[0, -1j], [1j, 0]]), np.array([[1, 0], [0, -1]])]
+    H = np.zeros((1 << n, 1 << n), complex)
+    for k in range(3):
+        term = functools.reduce(np.kron,
+                                [P2[c] for c in codes[k][::-1]])
+        H = H + coeffs[k] * term
+    expect = float(np.real(vec.conj() @ H @ vec))
+    assert abs(got - expect) < 1e-10
+
+
 def test_quad_register_lifecycle(env, quad):
     """The full gate path runs at prec 4 (f64 storage, tighter eps)."""
     q = qt.createQureg(5, env)
