@@ -125,7 +125,7 @@ def config1(env):
             qt.controlledRotateX(q, t - 1, t, 0.3)
         return qt.calcProbOfOutcome(q, n - 1, 0)
 
-    api = wall_stats(api_run)
+    api = wall_stats(api_run, reps=3)
 
     from functools import partial
 
@@ -199,7 +199,7 @@ def config3(env):
         amp_box[0] = float(circuits.amp00_canonical(a))
         return time.perf_counter() - t0
 
-    st = kdiff_stats(run_k, khi=8)
+    st = kdiff_stats(run_k, reps=4, khi=8)
     # the last timed run chains an EVEN number of QFTs: QFT^2 maps
     # |0..0> back to |0..0> (it is the index-negation permutation), so
     # amp0 ~= 1 — an in-artifact correctness check; an odd run would
@@ -243,16 +243,16 @@ def config4(env):
         return time.perf_counter() - t0
 
     out = {"metric": f"{n}q density noise + fidelity"}
-    out["eager"] = kdiff_stats(lambda k: run_variant(False, k), reps=3,
+    out["eager"] = kdiff_stats(lambda k: run_variant(False, k), reps=2,
                                khi=4)
     prev = os.environ.get("QT_CHAN_SWEEP")
     try:
         os.environ["QT_CHAN_SWEEP"] = "1"
         out["fused_sweep_on"] = kdiff_stats(
-            lambda k: run_variant(True, k), reps=3, khi=4)
+            lambda k: run_variant(True, k), reps=2, khi=4)
         os.environ["QT_CHAN_SWEEP"] = "0"
         out["fused_sweep_off"] = kdiff_stats(
-            lambda k: run_variant(True, k), reps=3, khi=4)
+            lambda k: run_variant(True, k), reps=2, khi=4)
     finally:
         if prev is None:
             os.environ.pop("QT_CHAN_SWEEP", None)
@@ -280,7 +280,7 @@ def config5(env):
             qt.applyTrotterCircuit(psi, hamil, 0.1, 2, 1)
         return time.perf_counter() - t0
 
-    st = kdiff_stats(run_k, reps=5, khi=8)
+    st = kdiff_stats(run_k, reps=4, khi=8)
 
     # component marginals (probe_config5_decomp decomposition carried
     # in-artifact): the trotter stream pipelines across iterations (its
@@ -339,9 +339,9 @@ def config5(env):
         return time.perf_counter() - t0
 
     return {"metric": f"{n}q PauliHamil expec + Trotter", "kdiff": st,
-            "trotter_kdiff": kdiff_stats(run_trotter, reps=3, khi=8),
-            "expec_kdiff": kdiff_stats(run_expec, reps=3, khi=8),
-            "fused_device_kdiff": kdiff_stats(run_device, reps=3, khi=8),
+            "trotter_kdiff": kdiff_stats(run_trotter, reps=2, khi=8),
+            "expec_kdiff": kdiff_stats(run_expec, reps=2, khi=8),
+            "fused_device_kdiff": kdiff_stats(run_device, reps=2, khi=8),
             "energy": e_box[0]}
 
 

@@ -1,0 +1,165 @@
+"""GSPMD vs explicit-collective A/B for the 1q sharded-target gate
+(VERDICT r4 item 7 / SURVEY.md §7 layer 5: "benchmark both").
+
+Representative op: a dense 1q gate on the TOP qubit of an n-qubit
+register amplitude-sharded over an 8-device mesh — the simplest op whose
+amplitude pairs straddle shards (the reference's exchangeStateVectors
+case, QuEST_cpu_distributed.c:489-517).
+
+A: explicit path — dist.apply_matrix_1q_sharded (shard_map, ONE
+   hypercube ppermute, pinned by tests/test_distributed_hlo.py).
+B: GSPMD path — the ordinary kernels.apply_matrix jitted with sharded
+   in/out shardings; XLA's sharding propagation decides the collectives.
+
+Measured on the virtual 8-device CPU mesh: the optimized-HLO collective
+histogram + exchanged-byte estimate for both, plus wall-clock (CPU wall
+is indicative only; the structural histogram is the durable evidence).
+On the real chip, a 1-device mesh run checks both paths execute and
+agree bitwise (a 1-mesh ppermute is the identity permutation).
+"""
+
+import json
+import os
+import re
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+_COLLECTIVE_OPS = (
+    "all-reduce", "all-reduce-start", "collective-permute",
+    "collective-permute-start", "all-gather", "all-gather-start",
+    "all-to-all", "reduce-scatter",
+)
+
+
+def hist_of(txt):
+    h = {}
+    for op in _COLLECTIVE_OPS:
+        c = txt.count(f" {op}(")
+        if c:
+            h[op] = h.get(op, 0) + c
+    return h
+
+
+def collective_bytes(txt):
+    """Rough exchanged-data estimate: sum of output-shape elements of
+    collective instructions (f32)."""
+    total = 0
+    for line in txt.splitlines():
+        m = re.search(r"= (\S+)\[([\d,]*)\][^ ]* (?:all-to-all|all-gather|"
+                      r"collective-permute|all-reduce)(?:-start)?\(", line)
+        if m and m.group(2):
+            elems = 1
+            for d in m.group(2).split(","):
+                elems *= int(d)
+            total += elems * 4
+    return total
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    res = {"backend": jax.default_backend()}
+
+    import quest_tpu as qt
+    from quest_tpu.ops import kernels
+    from quest_tpu.parallel import dist as PAR
+
+    env = qt.createQuESTEnv()
+    ndev = env.num_ranks
+    res["ndev"] = ndev
+    n = 20 if not on_tpu else 24
+    res["n"] = n
+
+    h2 = (1 / np.sqrt(2)) * np.array([[1, 1], [1, -1]])
+    m = jnp.asarray(np.stack([h2, np.zeros((2, 2))]), jnp.float32)
+
+    rng = np.random.default_rng(0)
+    a_host = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    a_host /= np.sqrt((a_host ** 2).sum())
+    amps = jax.device_put(jnp.asarray(a_host), env.amp_sharding())
+
+    def explicit(a):
+        if ndev == 1:
+            # r=0: every target is local — the explicit layer routes the
+            # ordinary kernel (the same reduction both paths share); the
+            # chip run checks execution + agreement at that fixed point
+            return kernels.apply_matrix(a, m, num_qubits=n,
+                                        targets=(n - 1,))
+        return PAR.apply_matrix_1q_sharded(
+            a, m, mesh=env.mesh, num_qubits=n, target=n - 1)
+
+    def gspmd(a):
+        out = kernels.apply_matrix(a, m, num_qubits=n, targets=(n - 1,))
+        return jax.lax.with_sharding_constraint(out, env.amp_sharding())
+
+    jg = jax.jit(gspmd)
+
+    if ndev > 1:
+        txt_a = jax.jit(explicit).lower(amps).compile().as_text()
+        txt_b = jg.lower(amps).compile().as_text()
+        res["explicit_hlo"] = hist_of(txt_a)
+        res["gspmd_hlo"] = hist_of(txt_b)
+        res["explicit_bytes"] = collective_bytes(txt_a)
+        res["gspmd_bytes"] = collective_bytes(txt_b)
+        print("explicit:", res["explicit_hlo"], res["explicit_bytes"],
+              "bytes", flush=True)
+        print("gspmd:   ", res["gspmd_hlo"], res["gspmd_bytes"],
+              "bytes", flush=True)
+
+    # numerical agreement
+    out_a = np.asarray(explicit(jax.device_put(jnp.asarray(a_host),
+                                               env.amp_sharding())))
+    out_b = np.asarray(jg(jax.device_put(jnp.asarray(a_host),
+                                         env.amp_sharding())))
+    res["maxdiff"] = float(np.max(np.abs(out_a - out_b)))
+    print("maxdiff:", res["maxdiff"], flush=True)
+
+    # wall per application (chained, single fetch) — INTERLEAVED t1/tk
+    # pairs per rep, like bench.kdiff_stats: phase-separated baselines
+    # let monotone chip drift between the phases corrupt the marginal
+    # (the first version of this probe recorded a physically impossible
+    # -0.496 s/op on the drifting chip that way)
+    def wall(fn, reps=5, k=8):
+        jfn = jax.jit(fn)
+
+        def run_k(kk):
+            a = jax.device_put(jnp.asarray(a_host), env.amp_sharding())
+            t0 = time.perf_counter()
+            for _ in range(kk):
+                a = jfn(a)
+            float(jnp.sum(a[0, :1]))
+            return time.perf_counter() - t0
+
+        run_k(1)
+        run_k(k)
+        t1s, tks = [], []
+        for _ in range(reps):
+            t1s.append(run_k(1))
+            tks.append(run_k(k))
+        return round((statistics.median(tks) - min(t1s)) / (k - 1), 5)
+
+    res["explicit_wall_per_op"] = wall(explicit)
+    res["gspmd_wall_per_op"] = wall(gspmd)
+    print("wall explicit:", res["explicit_wall_per_op"],
+          "gspmd:", res["gspmd_wall_per_op"], flush=True)
+
+    suffix = "tpu" if on_tpu else "cpu"
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       f"probe_gspmd_ab_{suffix}.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    if os.environ.get("QT_AB_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    main()

@@ -196,6 +196,45 @@ class TestExplicitDistLayer:
         assert hist.get("collective-permute", 0) >= 1, hist
 
 
+class TestGspmdAB:
+    """SURVEY.md §7 layer 5's explicit-vs-GSPMD benchmark, pinned
+    structurally (scripts/probes/probe_gspmd_ab.py carries the full
+    measurement): for the representative 1q sharded-target gate the
+    explicit layer exchanges 1 hypercube ppermute (one state pass of
+    bytes) while GSPMD propagation of the SAME local kernel emits
+    4 permutes + 2 all-gathers (~10.5x the exchanged bytes, measured
+    7x wall on the virtual mesh) — the quantitative reason the explicit
+    layer is the default."""
+
+    def test_gspmd_1q_gate_collectives_exceed_explicit(self, env8):
+        n = 14
+        amps = sharded_state(env8, n, 50)
+        h = (1 / np.sqrt(2)) * np.array([[1, 1], [1, -1]])
+        m = jnp.asarray(np.stack([h, np.zeros((2, 2))]))
+
+        def explicit(a):
+            return PAR.apply_matrix_1q_sharded(
+                a, m, mesh=env8.mesh, num_qubits=n, target=n - 1)
+
+        def gspmd(a):
+            out = K.apply_matrix(a, m, num_qubits=n, targets=(n - 1,))
+            return jax.lax.with_sharding_constraint(
+                out, env8.amp_sharding())
+
+        hist_a = collective_ops(explicit, amps)
+        hist_b = collective_ops(gspmd, amps)
+        assert hist_a == {"collective-permute": 1}, hist_a
+        # GSPMD must communicate MORE than the explicit path (today:
+        # 4 permutes + 2 all-gathers); equal-or-fewer would mean XLA
+        # caught up and the default deserves re-measurement
+        assert sum(hist_b.values()) > 1, hist_b
+        # and both compute the same state (fresh arrays: the explicit
+        # kernel donates its input)
+        out_a = np.asarray(explicit(sharded_state(env8, n, 50)))
+        out_b = np.asarray(jax.jit(gspmd)(sharded_state(env8, n, 50)))
+        np.testing.assert_allclose(out_a, out_b, atol=1e-12)
+
+
 class TestPairFamiliesCommunicate:
     def test_explicit_depolarising_one_permute(self, env8):
         """The explicit pair-exchange channel is EXACTLY one
