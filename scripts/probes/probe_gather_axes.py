@@ -1,0 +1,95 @@
+"""Where does the direct-rotation term's 2.2 ms (24q, quiet session) go?
+Theoretical floor is ~3 HBM passes (~0.5 ms).  Scan variants whose flip
+mask touches ONLY the row (hi) axis, ONLY the lane (lo) axis, both, or
+neither.
+
+CAVEATS on interpretation: "none" (all-Z codes) is NOT a gather-free
+control — the traced fm=0 still executes both identity-index takes
+(codes are scan-carried, XLA cannot fold them) and it is the only mode
+with nonzero parity-sign work, while the X-only modes pay gathers but
+no parity mask.  So mode differences bound, rather than cleanly
+attribute, per-axis gather cost.  The first recorded run
+(probe_gather_axes_result.json) was additionally drift-invalidated
+(mode orderings physically impossible: "rows" < 0 < "none"); re-run on
+a quiet session before drawing tuning conclusions.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    LO = P._GATHER_LO_BITS
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+    KHI = 8
+    T = 16
+
+    def state():
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jnp.asarray(a)
+
+    def marginal(label, run_k, reps=5, khi=KHI):
+        run_k(1)
+        run_k(khi)
+        t1s, tks = [], []
+        for _ in range(reps):
+            t1s.append(run_k(1))
+            tks.append(run_k(khi))
+        m = round((statistics.median(tks) - min(t1s)) / (khi - 1), 5)
+        res[label] = m
+        print(label, m, flush=True)
+
+    angles = jnp.asarray(rng.normal(size=T))
+
+    def scan_with_mask(mask_mode):
+        """The real direct-rotation scan body, codes chosen so the flip
+        mask hits only the requested axis."""
+        if mask_mode == "none":
+            codes = np.full((T, n), 3, np.int32)        # all Z: no flip
+        elif mask_mode == "lanes":
+            codes = np.zeros((T, n), np.int32)
+            codes[:, :LO] = rng.integers(0, 2, size=(T, LO)) * 1  # X on lo
+        elif mask_mode == "rows":
+            codes = np.zeros((T, n), np.int32)
+            codes[:, LO:] = rng.integers(0, 2, size=(T, n - LO)) * 1
+        else:  # both
+            codes = rng.integers(0, 4, size=(T, n)).astype(np.int32)
+        cj = jnp.asarray(codes)
+
+        def run_k(k):
+            a = state()
+            t0 = time.perf_counter()
+            for _ in range(k):
+                a = P.trotter_scan(a, cj, angles, num_qubits=n,
+                                   rep_qubits=n)
+            float(jnp.sum(a[0, :1]))
+            return time.perf_counter() - t0
+
+        return run_k
+
+    for mode in ("none", "lanes", "rows", "both"):
+        marginal(f"scan_flip_{mode}", scan_with_mask(mode))
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_gather_axes_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,13 @@ def test_vqe_train():
     assert "done; final energy" in r.stdout
 
 
+def test_trotter_evolution():
+    r = _run("trotter_evolution.py", env_extra={"QT_EVOLVE_QUBITS": "8",
+                                                "QT_EVOLVE_STEPS": "10"})
+    assert r.returncode == 0, r.stderr
+    assert "energy drift" in r.stdout and "OK" in r.stdout
+
+
 def test_qaoa_maxcut():
     r = _run("qaoa_maxcut.py", env_extra={"QT_QAOA_QUBITS": "6"})
     assert r.returncode == 0, r.stderr
