@@ -20,7 +20,11 @@ dispatch more — fixed per-call harness overheads — and the shared chip
 drifts on a seconds scale.  Large-K contrast
 (T[K iters] - best T[1 iter]) / (K - 1), K in {4, 8, 16}, cancels the
 fixed overheads AND bounds drift's reach (one spike moves one rep);
-median/min/spread over reps are reported (VERDICT r4 item 3).  The
+median/min/spread over reps are reported (VERDICT r4 item 3).  Config 2
+alone uses paired K=2 differences: its iteration is 27 small programs,
+and sustained large K crosses into the host-dispatch-bound regime
+(~3.7 ms/program through the relay) — that rate is reported separately
+as sustained_k16_dispatch_bound.  The
 persistent XLA compilation cache (quest_tpu.env) makes every session
 after the first start warm; per-config compile_s records what THIS
 session paid.
@@ -58,18 +62,20 @@ REPS = int(os.environ.get("QT_BENCH_REPS", "3" if CPU else "5"))
 
 
 def kdiff_stats(run_k, reps=REPS, warm=True, khi=2):
-    """Drift-resistant marginal cost per iteration via LARGE-K contrast
-    (VERDICT r4 item 3): the chip's session drift inflates (and can even
-    negate) the 2x form d = T[2]-T[1], so the marginal is taken against
-    the cleanest observed single-iteration time,
+    """Drift-resistant marginal cost per iteration (VERDICT r4 item 3).
 
-        marg = (T[K] - min_j T_j[1]) / (K - 1),   K >= 4
+    khi >= 4: large-K contrast marg = (T[K] - min_j T_j[1]) / (K - 1) —
+    the subtrahend is the drift-free best single run (negative minima
+    cannot arise from an inflated T[1] draw), one drift spike moves one
+    rep, and T1's dispatch jitter enters only as jitter/(K-1).
 
-    reported as {median, min, spread} over the T[K] reps — min_j T_j[1]
-    is a drift-free best, so negative minima cannot arise from an
-    inflated T[1] draw, and one drift spike moves one rep, not the
-    whole statistic (the builder's probes validated the form in round 4:
-    scripts/probes/probe_trotter2.py, BASELINE.md)."""
+    khi == 2: PAIRED same-rep differences d_i = T_i[2] - T_i[1] — at 1x
+    nothing divides the jitter down, so the best-T1 subtrahend would
+    fold the full ~0.04 s dispatch jitter into the marginal (measured:
+    it reported 0.100 for a workload paired-d2 puts at 0.06); the
+    median over reps guards the paired form instead.  Used where large
+    K would cross into the host-dispatch-bound regime (config 2's
+    27-small-program iterations — BASELINE.md round-5 correction)."""
     assert khi >= 2, "large-K contrast needs khi >= 2"
     t0 = time.perf_counter()
     run_k(1)
@@ -80,15 +86,29 @@ def kdiff_stats(run_k, reps=REPS, warm=True, khi=2):
     for _ in range(reps):
         t1s.append(run_k(1))
         tks.append(run_k(khi))
-    t1_best = min(t1s)
-    margs = [(tk - t1_best) / (khi - 1) for tk in tks]
+    if khi == 2:
+        # paired same-rep differences: the best-T1 subtrahend would fold
+        # T1's full dispatch jitter (~0.04 s) into a 1x marginal — at
+        # khi=2 nothing divides it down.  Pairing keeps the estimate
+        # unbiased; the median over reps guards it (round-4 form).
+        margs = [tk - t1 for t1, tk in zip(t1s, tks)]
+        # each paired marg absorbs its own T1 draw, so the estimator's
+        # spread must come from the margs themselves (the raw-T[k] form
+        # below would under-report it)
+        spread = max(margs) - min(margs)
+    else:
+        # large K: one drift spike moves one rep, and the T1 jitter
+        # enters only as jitter/(K-1)
+        t1_best = min(t1s)
+        margs = [(tk - t1_best) / (khi - 1) for tk in tks]
+        spread = (max(tks) - min(tks)) / (khi - 1)
     return {
         "median": round(statistics.median(margs), 4),
         "min": round(min(margs), 4),
-        "spread": round((max(tks) - min(tks)) / (khi - 1), 4),
+        "spread": round(spread, 4),
         "reps": reps,
         "khi": khi,
-        "wall_single": round(t1_best, 4),
+        "wall_single": round(min(t1s), 4),
         "compile_s": round(compile_s, 1),
     }
 
@@ -176,12 +196,32 @@ def config2(env):
         prob_box[0] = float(circuits.prob_top_zero_canonical(a))
         return time.perf_counter() - t0
 
-    st = kdiff_stats(run_k, khi=16)
-    best = max(st["min"], 1e-9)
-    rate = num_gates * float(1 << N) / best
+    # DEVICE-time marginal: khi=2.  Config 2 is the one config whose
+    # iteration is 27 SMALL programs, so at large K the host dispatch
+    # rate through the relay (~3.7 ms/program, rock-stable ~0.101 s/iter
+    # at K=16) becomes the bottleneck and the contrast measures the
+    # harness, not the chip — measured side by side: d2 = 0.058-0.08 vs
+    # d16 = 0.101 in the same reps (BASELINE.md round-5).  khi=2 keeps
+    # the device marginal via paired per-rep differences, median of 7
+    # reps (NOT the best-T1 subtrahend — that folds the full dispatch
+    # jitter into a 1x marginal).  The sustained (dispatch-bound) rate
+    # is reported alongside for transparency.
+    st = kdiff_stats(run_k, reps=7, khi=2)
+    # warm=False: st's runs above already compiled and warmed run_k;
+    # drop the sustained call's meaningless compile_s reading too
+    sustained = kdiff_stats(run_k, reps=2, khi=16, warm=False)
+    sustained.pop("compile_s", None)
+    # the rate claims the MEDIAN paired diff: a single favorable-drift
+    # pair can deflate the min as easily as a spike inflates it (one run
+    # recorded min 0.0097 vs median 0.0589 — a 6x over-claim if used);
+    # a non-positive median means the session was too noisy to measure —
+    # report null rather than a clamped absurdity
+    rate = (num_gates * float(1 << N) / st["median"]
+            if st["median"] > 0 else None)
     return {"metric": f"{N}q depth-{DEPTH} random circuit",
             "kdiff": st, "gates": num_gates,
             "amp_updates_per_sec": rate,
+            "sustained_k16_dispatch_bound": sustained,
             "prob_check": prob_box[0]}
 
 
@@ -361,8 +401,8 @@ def main():
         configs[str(c)]["config_total_s"] = round(time.time() - t0, 1)
 
     c2 = configs.get("2", {})
-    best = c2.get("kdiff", {}).get("min")
-    value = c2.get("amp_updates_per_sec")
+    best = c2.get("kdiff", {}).get("min")   # "seconds" stays the min;
+    value = c2.get("amp_updates_per_sec")   # the rate uses the median
     baseline_shape = (N == 26 and DEPTH == 20) and value is not None
     print(json.dumps({
         "metric": f"{N}q depth-{DEPTH} random-circuit gate-apply rate",
@@ -373,9 +413,12 @@ def main():
         "seconds": best,
         "seconds_median": c2.get("kdiff", {}).get("median"),
         "seconds_spread": c2.get("kdiff", {}).get("spread"),
-        "timing": ("large-K contrast (T[Kx] - best T[1x])/(K-1), K=16; "
-                   "median/min/spread over reps; removes fixed relay "
-                   "fetch+dispatch overhead and bounds chip drift"),
+        "timing": ("config-2 headline: paired K=2 diffs (T[2x]-T[1x] per "
+                   "rep, 7 reps) — device-time marginal; other configs "
+                   "large-K contrast (T[Kx]-best T[1x])/(K-1), K in "
+                   "{4,8,16}; removes fixed relay fetch overhead, bounds "
+                   "drift; sustained dispatch-bound rate reported "
+                   "separately"),
         "backend": jax.default_backend(),
         "total_bench_s": round(time.time() - t_start, 1),
         "configs": configs,
