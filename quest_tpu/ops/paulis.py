@@ -404,6 +404,184 @@ def _direct_rotation(amps, codes, ang, nq: int, offset: int, n: int,
                       co * amps[1] - si * pv[0]])
 
 
+# ---------------------------------------------------------------------------
+# Pallas fused direct rotation: the whole term in ONE HBM pass per block
+# (scripts/probes/probe_flip_pallas.py measured 2.3x over the take-take
+# gather at 24q, bit-identical).  The XOR permutation decomposes as
+#   - block-level row XOR: the flip input's BlockSpec index_map reads
+#     block (i ^ (fm_row >> 8)) — pure DMA redirection;
+#   - in-block row XOR (8 bits) and lane XOR (7 bits): dynamically built
+#     0/1 permutation matmuls (256x256 and 128x128) on the MXU — Mosaic
+#     has no rev lowering, and at HIGHEST precision a permutation matmul
+#     is exact;
+# parity signs factor as s_row (x) s_lane, built OUTSIDE the kernel.
+# ---------------------------------------------------------------------------
+
+_PL_BR = 256            # rows per block (n >= _PL_MIN_N so R >= _PL_BR)
+_PL_MIN_N = 15
+
+
+def _pl_routable(amps, n: int) -> bool:
+    return (_PL_MIN_N <= n <= 32 and amps.dtype == jnp.float32
+            and jax.default_backend() == "tpu")
+
+
+def _pl_flip_signed(meta, fvals, x_ref, f_ref, srow_ref, slane_ref):
+    """Shared kernel-body algebra: load the two blocks, apply the
+    in-block row XOR and lane XOR as exact permutation matmuls, and
+    return (x, pr, pi) with the parity sign and (-i)^{#Y} factor folded
+    in — used by both the rotation and the expectation kernels."""
+    from jax import lax
+
+    rb = meta[1]
+    fl = meta[2]
+    x = x_ref[...]                  # (2, BR, 128)
+    f = f_ref[...]
+    hi = lax.Precision.HIGHEST
+    ri = lax.broadcasted_iota(jnp.int32, (_PL_BR, _PL_BR), 0)
+    rj = lax.broadcasted_iota(jnp.int32, (_PL_BR, _PL_BR), 1)
+    prow = ((ri ^ rb) == rj).astype(x.dtype)
+    f = jnp.concatenate([
+        jnp.dot(prow, f[0], preferred_element_type=x.dtype,
+                precision=hi)[None],
+        jnp.dot(prow, f[1], preferred_element_type=x.dtype,
+                precision=hi)[None],
+    ])
+    li = lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    lj = lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    perm = ((li ^ fl) == lj).astype(x.dtype)
+    pv = jnp.dot(f.reshape(2 * _PL_BR, 128), perm,
+                 preferred_element_type=x.dtype,
+                 precision=hi).reshape(2, _PL_BR, 128)
+    s = (srow_ref[...][:, 0][None, :, None]
+         * slane_ref[...][0][None, None, :])[0]
+    c_re = fvals[0, 2]
+    c_im = fvals[0, 3]
+    pr = s * (c_re * pv[0] - c_im * pv[1])
+    pi = s * (c_re * pv[1] + c_im * pv[0])
+    return x, pr, pi
+
+
+def _pl_rotation_kernel(meta, fvals, x_ref, f_ref, srow_ref, slane_ref,
+                        out_ref):
+    x, pr, pi = _pl_flip_signed(meta, fvals, x_ref, f_ref, srow_ref,
+                                slane_ref)
+    co = fvals[0, 0]
+    si = fvals[0, 1]
+    out_ref[0, :, :] = co * x[0] + si * pi
+    out_ref[1, :, :] = co * x[1] - si * pr
+
+
+def _pl_expec_kernel(meta, fvals, x_ref, f_ref, srow_ref, slane_ref,
+                     out_ref):
+    """Per-term expectation contribution Re <x| c P |x> accumulated
+    across the sequential grid: flip (same permutation algebra as the
+    rotation kernel) + sign + product-reduce, one HBM pass."""
+    import jax.experimental.pallas as pl
+    from jax import lax
+
+    i = pl.program_id(0)
+    x, pr, pi = _pl_flip_signed(meta, fvals, x_ref, f_ref, srow_ref,
+                                slane_ref)
+    partial = jnp.sum(x[0] * pr + x[1] * pi).reshape(1, 1)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros((1, 1), x.dtype)
+
+    out_ref[...] += partial
+
+
+def _pl_term_inputs(amps, codes, ang, nq: int, offset: int, n: int,
+                    conj: bool):
+    """(meta, fvals, view, s_row, s_lane) shared by the two Pallas term
+    kernels."""
+    dt = amps.dtype
+    R = 1 << (n - 7)
+    fm_lo, fm_hi, zlo, zhi, ny = _direct_masks(codes, nq, offset, n)
+    fm = fm_lo.astype(jnp.uint32)
+    if n > _GATHER_LO_BITS:
+        fm = fm | (fm_hi << _GATHER_LO_BITS)
+    fm_lane = (fm & jnp.uint32(127)).astype(jnp.int32)
+    fm_row = (fm >> 7).astype(jnp.int32)
+    meta = jnp.stack([fm_row >> 8, fm_row & 255, fm_lane])
+    s_full = _parity_sign_dynamic(zlo, zhi, n, dt)
+    # parity factorises: s(r*128 + l) = s_row(r) * s_lane(l)
+    s_lane = s_full[:128].reshape(1, 128)
+    s_row = s_full.reshape(R, 128)[:, :1]
+    theta = jnp.where((fm_lo | fm_hi | zlo | zhi) == 0,
+                      jnp.asarray(0.0, dt), ang)
+    c_re, c_im = _iexp_factor(ny, dt)
+    if conj:
+        c_im = -c_im
+    fvals = jnp.stack([jnp.cos(0.5 * theta), jnp.sin(0.5 * theta),
+                       c_re, c_im]).reshape(1, 4)
+    return meta, fvals, amps.reshape(2, R, 128), s_row, s_lane
+
+
+def _pl_grid_spec(R, out_blockspec):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R // _PL_BR,),
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda i, meta: (0, 0)),
+            pl.BlockSpec((2, _PL_BR, 128), lambda i, meta: (0, i, 0)),
+            pl.BlockSpec((2, _PL_BR, 128),
+                         lambda i, meta: (0, i ^ meta[0], 0)),
+            pl.BlockSpec((_PL_BR, 1), lambda i, meta: (i, 0)),
+            pl.BlockSpec((1, 128), lambda i, meta: (0, 0)),
+        ],
+        out_specs=out_blockspec,
+    )
+
+
+def _expec_term_pallas(amps, codes, n: int):
+    """Re <amps| P |amps> with a traced code row, one fused HBM pass."""
+    import jax
+    import jax.experimental.pallas as pl
+
+    from . import fused as _fused
+
+    meta, fvals, view, s_row, s_lane = _pl_term_inputs(
+        amps, codes, jnp.zeros((), amps.dtype), n, 0, n, conj=False)
+    R = view.shape[1]
+    out = pl.pallas_call(
+        _pl_expec_kernel,
+        grid_spec=_pl_grid_spec(
+            R, pl.BlockSpec((1, 1), lambda i, meta: (0, 0))),
+        out_shape=jax.ShapeDtypeStruct((1, 1), view.dtype),
+        interpret=_fused._interpret_default(),
+    )(meta, fvals, view, view, s_row, s_lane)
+    return out[0, 0]
+
+
+def _direct_rotation_pallas(amps, codes, ang, nq: int, offset: int,
+                            n: int, conj: bool):
+    """One fused-HBM-pass direct rotation (15 <= n <= 32); bit-identical
+    to _direct_rotation by construction (exact permutation matmuls + the
+    same sign/factor algebra)."""
+    import jax
+    import jax.experimental.pallas as pl
+
+    from . import fused as _fused
+
+    meta, fvals, view, s_row, s_lane = _pl_term_inputs(
+        amps, codes, ang, nq, offset, n, conj)
+    R = view.shape[1]
+    out = pl.pallas_call(
+        _pl_rotation_kernel,
+        grid_spec=_pl_grid_spec(
+            R, pl.BlockSpec((2, _PL_BR, 128),
+                            lambda i, meta: (0, i, 0))),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        interpret=_fused._interpret_default(),
+    )(meta, fvals, view, view, s_row, s_lane)
+    return out.reshape(amps.shape)
+
+
 @partial(jax.jit, static_argnames=("num_qubits", "rep_qubits"),
          donate_argnums=0)
 def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
@@ -435,14 +613,23 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
         return amps
 
     is_density = n == 2 * nq
+    # fused Pallas term for block-decomposable sizes (one HBM pass per
+    # term, 2.3x the take-take gather; u32 mask recombination caps at 32
+    # state bits).  Real-Mosaic only for f32 on TPU: Mosaic has no f64
+    # dot lowering (fused._resolve_interpret documents the same
+    # constraint), and on CPU the interpreted grid would be far slower
+    # than the fused XLA gather — both take the gather form instead
+    # (tests/test_direct_rotation.py drives the kernels directly in
+    # interpret mode to keep them covered off-TPU).
+    rot = (_direct_rotation_pallas if _pl_routable(amps, n)
+           else _direct_rotation)
 
     def body(carry, inp):
         codes, ang = inp
         ang = ang.astype(dt)
-        carry = _direct_rotation(carry, codes, ang, nq, 0, n, conj=False)
+        carry = rot(carry, codes, ang, nq, 0, n, conj=False)
         if is_density:
-            carry = _direct_rotation(carry, codes, -ang, nq, nq, n,
-                                     conj=True)
+            carry = rot(carry, codes, -ang, nq, nq, n, conj=True)
         return carry, None
 
     amps, _ = jax.lax.scan(body, amps, (codes_seq, angles))
@@ -487,15 +674,23 @@ def expec_pauli_sum_scan(amps, codes_seq, coeffs, *, num_qubits: int,
         return _calc.neumaier_sum(vals) if quad else total
 
     # direct form: Re <psi| c_t P_t |psi> = c_t * sum_i (psi_r pr +
-    # psi_i pi) with (pr, pi) = P psi via one split-axis gather — one
-    # state pass per term instead of a basis-rotation layer + reduce
+    # psi_i pi) with (pr, pi) = P psi — fused flip+sign+reduce Pallas
+    # kernel (one HBM pass per term) at block-decomposable sizes; the
+    # split-axis gather + reduce otherwise.  Quad keeps the gather form:
+    # its channel-split double-double accumulation needs the full
+    # product vectors, not f32 block partials.
+    use_pl = not quad and _pl_routable(amps, n)
+
     def body(acc, inp):
         codes, coeff = inp
-        pv, _ = _apply_pauli_traced(amps, codes, n, 0, n, conj=False)
-        if quad:
-            r = _calc.quad_sum2(amps[0] * pv[0], amps[1] * pv[1])
+        if use_pl:
+            r = _expec_term_pallas(amps, codes, n)
         else:
-            r = jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
+            pv, _ = _apply_pauli_traced(amps, codes, n, 0, n, conj=False)
+            if quad:
+                r = _calc.quad_sum2(amps[0] * pv[0], amps[1] * pv[1])
+            else:
+                r = jnp.sum(amps[0] * pv[0] + amps[1] * pv[1])
         v = coeff.astype(dt) * r
         return acc + v, v
 

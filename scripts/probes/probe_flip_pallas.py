@@ -1,0 +1,195 @@
+"""Pallas fused direct-rotation term vs the take-take XLA form
+(INTERLEAVED A/B — see probe_flip_variants.py for the XLA-level sweep
+this continues; take-take measured 0.076 s/16 terms, ~3x above the HBM
+floor, with both alternative XLA formulations slower).
+
+The Pallas kernel does the whole term in ONE HBM pass per block:
+  out = cos*x + sin * s ⊙ ((-i)^{#Y} * x[i ^ fm])
+with the XOR permutation decomposed as
+  - block-level row XOR: the flip input's BlockSpec index_map reads
+    block (i ^ (fm_row >> 8)) — pure DMA redirection, zero data cost;
+  - in-block row XOR (8 bits): a 256x256 dynamically built 0/1
+    permutation matmul (Mosaic has no rev lowering; MXU cost is trivial
+    next to the DMA);
+  - lane XOR (7 bits): one 128x128 dynamically built 0/1 permutation
+    matmul on the MXU.
+Parity signs factor as s_row (x) s_lane, precomputed OUTSIDE the kernel
+(tiny vectors) so no popcount lowers inside Mosaic.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, "/root/repo")
+
+
+def build(n):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from quest_tpu.ops import paulis as P
+
+    LANE = 7
+    BR = 256                       # rows per block
+    R = 1 << (n - LANE)
+
+    def kernel(meta, fvals, x_ref, f_ref, srow_ref, slane_ref, out_ref):
+        rb = meta[1]               # in-block row XOR (8 bits)
+        fl = meta[2]               # lane XOR (7 bits)
+        x = x_ref[...]             # (2, BR, 128)
+        f = f_ref[...]
+        # in-block row XOR as a 256x256 permutation matmul (Mosaic has
+        # no rev lowering; the MXU cost is trivial next to the DMA)
+        ri = lax.broadcasted_iota(jnp.int32, (BR, BR), 0)
+        rj = lax.broadcasted_iota(jnp.int32, (BR, BR), 1)
+        prow = ((ri ^ rb) == rj).astype(x.dtype)
+        f = jnp.concatenate([
+            jnp.dot(prow, f[0], preferred_element_type=x.dtype,
+                    precision=lax.Precision.HIGHEST)[None],
+            jnp.dot(prow, f[1], preferred_element_type=x.dtype,
+                    precision=lax.Precision.HIGHEST)[None],
+        ])
+        li = lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+        lj = lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+        perm = ((li ^ fl) == lj).astype(x.dtype)
+        pv = jnp.dot(f.reshape(2 * BR, 128), perm,
+                     preferred_element_type=x.dtype,
+                     precision=lax.Precision.HIGHEST).reshape(2, BR, 128)
+        s = srow_ref[...][:, 0][None, :, None] * slane_ref[...][0][None, None, :]
+        co = fvals[0, 0]
+        si = fvals[0, 1]
+        c_re = fvals[0, 2]
+        c_im = fvals[0, 3]
+        pr = s[0] * (c_re * pv[0] - c_im * pv[1])
+        pi = s[0] * (c_re * pv[1] + c_im * pv[0])
+        out_ref[0, :, :] = co * x[0] + si * pi
+        out_ref[1, :, :] = co * x[1] - si * pr
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R // BR,),
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda i, meta: (0, 0)),
+            pl.BlockSpec((2, BR, 128), lambda i, meta: (0, i, 0)),
+            pl.BlockSpec((2, BR, 128), lambda i, meta: (0, i ^ meta[0], 0)),
+            pl.BlockSpec((BR, 1), lambda i, meta: (i, 0)),
+            pl.BlockSpec((1, 128), lambda i, meta: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, BR, 128), lambda i, meta: (0, i, 0)),
+    )
+
+    def term(amps, cd, ang):
+        import numpy as np
+
+        dt = amps.dtype
+        fm_lo, fm_hi, zlo, zhi, ny = P._direct_masks(cd, n, 0, n)
+        fm = fm_lo.astype(jnp.uint32) | (fm_hi << P._GATHER_LO_BITS if
+                                         n > P._GATHER_LO_BITS else 0)
+        # recombine then re-split for the kernel's (block, inblock, lane)
+        fm_lane = (fm & jnp.uint32(127)).astype(jnp.int32)
+        fm_row = (fm >> 7).astype(jnp.int32)
+        meta = jnp.stack([fm_row >> 8, fm_row & 255, fm_lane])
+        s_full = P._parity_sign_dynamic(zlo, zhi, n, dt)
+        # parity factorises: s(r*128 + l) = s_row(r) * s_lane(l)
+        s_lane = lax.dynamic_slice(s_full, (0,), (128,)).reshape(1, 128)
+        s_row = s_full.reshape(R, 128)[:, :1]  # value at lane 0 per row
+        theta = jnp.where((fm_lo | fm_hi | zlo | zhi) == 0,
+                          jnp.asarray(0.0, dt), ang.astype(dt))
+        c_re, c_im = P._iexp_factor(ny, dt)
+        fvals = jnp.stack([jnp.cos(0.5 * theta), jnp.sin(0.5 * theta),
+                           c_re, c_im]).reshape(1, 4)
+        view = amps.reshape(2, R, 128)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        )(meta, fvals, view, view, s_row, s_lane)
+        return out.reshape(amps.shape)
+
+    @jax.jit
+    def prog(a, cds, angs):
+        def body(carry, inp):
+            cd, ang = inp
+            return term(carry, cd, ang), None
+        out, _ = jax.lax.scan(body, a, (cds, angs))
+        return out
+
+    return prog
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+    T = 16
+    codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+    angles = jnp.asarray(rng.normal(size=T))
+    a0 = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    a0 /= np.sqrt((a0 ** 2).sum())
+    a_dev = jnp.asarray(a0)
+
+    prog_pl = build(n)
+    ref = P.trotter_scan(jnp.array(a_dev), codes, angles, num_qubits=n,
+                         rep_qubits=n)
+    got = prog_pl(jnp.array(a_dev), codes, angles)
+    md = float(jnp.max(jnp.abs(got - ref)))
+    res["maxdiff_pallas"] = md
+    print(f"maxdiff_pallas: {md:.2e}", flush=True)
+    assert md < 1e-6, md
+
+    def run_take(k):
+        a = jnp.array(a_dev)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = P.trotter_scan(a, codes, angles, num_qubits=n,
+                               rep_qubits=n)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    def run_pl(k):
+        a = jnp.array(a_dev)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = prog_pl(a, codes, angles)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    K = 8
+    for f in (run_take, run_pl):
+        f(1)
+        f(K)
+    m_take, m_pl = [], []
+    for _ in range(5):
+        t1 = run_take(1); tk = run_take(K)
+        m_take.append((tk - t1) / (K - 1))
+        t1 = run_pl(1); tk = run_pl(K)
+        m_pl.append((tk - t1) / (K - 1))
+    res["take_take"] = {"median": round(statistics.median(m_take), 5),
+                        "min": round(min(m_take), 5)}
+    res["pallas_fused"] = {"median": round(statistics.median(m_pl), 5),
+                           "min": round(min(m_pl), 5)}
+    print("take_take:", res["take_take"], flush=True)
+    print("pallas_fused:", res["pallas_fused"], flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_flip_pallas_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

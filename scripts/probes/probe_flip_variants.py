@@ -1,0 +1,154 @@
+"""INTERLEAVED A/B of traced-XOR-flip implementations for the direct
+Pauli rotation (paulis._flip_gather, currently take(rows) + take(lanes)
+at a 12-bit split; ~2.2 ms/term quiet vs ~0.5 ms HBM floor).
+
+Variants (each embedded in the same scan + rotation-combine structure so
+the comparison is end-to-end per term):
+
+  A. current: take(axis=rows 2^12) + take(axis=lanes 2^12)
+  B. rows + mid + MXU lane permutation: view (2, 2^12, 2^5, 128);
+     take rows (16 KB rows), take the 32-wide mid axis, then XOR the low
+     7 lane bits by right-multiplying with a dynamically built 128x128
+     0/1 permutation matrix (P[i, j] = [j == i ^ fm7]) — lane shuffles
+     become one MXU pass instead of a 4096-wide lane gather.
+  C. like B but lane bits via take on the 128 axis (isolates whether the
+     wide lane gather in A is the cost).
+
+Timing: interleaved per-rep rotation A->B->C->A->... with paired large-K
+contrast per variant — RELATIVE ordering survives drift because every
+variant samples every chip regime (the round-5 lesson: phase-separated
+timings on this chip are meaningless).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.ops import paulis as P
+
+    n = 24
+    rng = np.random.default_rng(0)
+    res = {"n": n}
+    T = 16
+    codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
+    angles = jnp.asarray(rng.normal(size=T))
+
+    def state():
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jnp.asarray(a)
+
+    LO = 12
+    MID = 5
+    LANE = 7
+
+    def flip_a(amps, fm_lo, fm_hi):
+        return P._flip_gather(amps, fm_lo, fm_hi, n)
+
+    def flip_b(amps, fm_lo, fm_hi):
+        hi = n - LO
+        v = amps.reshape(2, 1 << hi, 1 << MID, 128)
+        idx_hi = jax.lax.iota(jnp.uint32, 1 << hi) ^ fm_hi
+        v = jnp.take(v, idx_hi, axis=1)
+        idx_mid = jax.lax.iota(jnp.uint32, 1 << MID) ^ (fm_lo >> LANE)
+        v = jnp.take(v, idx_mid, axis=2)
+        lane = jax.lax.iota(jnp.uint32, 128)
+        perm = (lane[:, None] ^ (fm_lo & jnp.uint32(127))
+                == lane[None, :]).astype(amps.dtype)
+        v = jnp.matmul(v, perm, precision=jax.lax.Precision.HIGHEST)
+        return v.reshape(2, -1)
+
+    def flip_c(amps, fm_lo, fm_hi):
+        hi = n - LO
+        v = amps.reshape(2, 1 << hi, 1 << MID, 128)
+        idx_hi = jax.lax.iota(jnp.uint32, 1 << hi) ^ fm_hi
+        v = jnp.take(v, idx_hi, axis=1)
+        idx_mid = jax.lax.iota(jnp.uint32, 1 << MID) ^ (fm_lo >> LANE)
+        v = jnp.take(v, idx_mid, axis=2)
+        idx_lane = jax.lax.iota(jnp.uint32, 128) ^ (fm_lo & jnp.uint32(127))
+        v = jnp.take(v, idx_lane, axis=3)
+        return v.reshape(2, -1)
+
+    def scan_of(flip_fn):
+        @jax.jit
+        def prog(a, cds, angs):
+            def body(carry, inp):
+                cd, ang = inp
+                dt = carry.dtype
+                fm_lo, fm_hi, zlo, zhi, ny = P._direct_masks(cd, n, 0, n)
+                s = P._parity_sign_dynamic(zlo, zhi, n, dt)
+                c_re, c_im = P._iexp_factor(ny, dt)
+                pv = flip_fn(carry, fm_lo, fm_hi)
+                pr = s * (c_re * pv[0] - c_im * pv[1])
+                pi = s * (c_re * pv[1] + c_im * pv[0])
+                theta = jnp.where((fm_lo | fm_hi | zlo | zhi) == 0,
+                                  jnp.asarray(0.0, dt), ang.astype(dt))
+                co, si = jnp.cos(0.5 * theta), jnp.sin(0.5 * theta)
+                out = jnp.stack([co * carry[0] + si * pi,
+                                 co * carry[1] - si * pr])
+                return out, None
+            out, _ = jax.lax.scan(body, a, (cds, angs))
+            return out
+        return prog
+
+    progs = {"A_take_take": scan_of(flip_a),
+             "B_mxu_lane_perm": scan_of(flip_b),
+             "C_take3": scan_of(flip_c)}
+
+    # correctness: all three must match the production scan
+    a0 = state()
+    ref = P.trotter_scan(jnp.array(a0), codes, angles, num_qubits=n,
+                         rep_qubits=n)
+    for name, prog in progs.items():
+        got = prog(jnp.array(a0), codes, angles)
+        md = float(jnp.max(jnp.abs(got - ref)))
+        res[f"maxdiff_{name}"] = md
+        print(f"maxdiff_{name}: {md:.2e}", flush=True)
+        assert md < 1e-6, (name, md)
+
+    # interleaved timing: one (T1, T8) pair per variant per round
+    KHI = 8
+    ROUNDS = 5
+    a_dev = state()
+
+    def run_k(prog, k):
+        a = jnp.array(a_dev)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            a = prog(a, codes, angles)
+        float(jnp.sum(a[0, :1]))
+        return time.perf_counter() - t0
+
+    for prog in progs.values():           # warm every program first
+        run_k(prog, 1)
+        run_k(prog, KHI)
+    margs = {k: [] for k in progs}
+    for _ in range(ROUNDS):
+        for name, prog in progs.items():
+            t1 = run_k(prog, 1)
+            tk = run_k(prog, KHI)
+            margs[name].append((tk - t1) / (KHI - 1))
+    for name, ds in margs.items():
+        res[name] = {"median": round(statistics.median(ds), 5),
+                     "min": round(min(ds), 5)}
+        print(name, res[name], flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "probe_flip_variants_result.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=2)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
