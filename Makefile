@@ -5,11 +5,13 @@
 
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
+SHELL := /bin/bash
+
 
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test bench docs clean
+.PHONY: all native test verify bench docs clean
 
 all: native
 
@@ -20,6 +22,11 @@ $(NATIVE_SO): $(NATIVE_DIR)/scheduler.cc
 
 test: native
 	python -m pytest tests/ -q
+
+# The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
+# marker, collection errors surfaced, pass count echoed.
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench: native
 	python bench.py

@@ -448,42 +448,98 @@ def _shift(qureg: Qureg) -> int:
 
 
 def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
-    """Route a dense-matrix gate: explicit ppermute path for sharded target
-    qubits (the reference's Distributed kernels), ordinary kernel (GSPMD
-    propagation) otherwise — the locality predicate of
-    QuEST_cpu_distributed.c:366-371 as a trace-time branch."""
+    """Route a dense-matrix gate, updating the register IN PLACE: explicit
+    ppermute path for sharded target qubits (the reference's Distributed
+    kernels), ordinary kernel (GSPMD propagation) otherwise — the locality
+    predicate of QuEST_cpu_distributed.c:366-371 as a trace-time branch.
+
+    On a sharded register targets are addressed through the live
+    logical->physical permutation (Qureg._perm): a multi-target gate
+    reaching mesh-coordinate bits relocalizes with half-shard swaps and
+    does NOT swap back — the permutation persists (mpiQulacs-style
+    communication avoidance, arXiv:2203.16044), later gates hitting the
+    same qubits pay ZERO exchanges, and canonical order rematerializes
+    lazily on the next state read.  dist.use_lazy_remap(False) restores
+    the reference's eager swap-in/swap-out pairs
+    (QuEST_cpu_distributed.c:1447-1545)."""
     env = qureg.env
     n = _sv_n(qureg)
     # size of the amplitude-sharding axis, NOT total devices: meshes may
     # carry extra axes (e.g. the (dp, amps) training mesh)
     ndev = PAR.amp_axis_size(env.mesh) if env.mesh is not None else 1
-    amps = qureg.amps
     if ndev > 1 and (1 << n) > ndev and PAR.explicit_dist_enabled():
         nloc = n - PAR.num_shard_bits(env.mesh)
-        high = [t for t in targets if t >= nloc]
-        if high and len(targets) == 1:
-            return PAR.apply_matrix_1q_sharded(
-                amps, stacked, mesh=env.mesh, num_qubits=n, target=targets[0],
-                controls=controls, control_states=control_states,
-            )
-        if high:
-            swaps, new_targets = PAR.plan_relocalization(n, nloc, targets, controls)
-            if swaps is not None:
-                for lo, hi in swaps:
-                    amps = PAR.swap_sharded(
-                        amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
-                    )
-                amps = K.apply_matrix(
-                    amps, stacked, num_qubits=n, targets=new_targets,
-                    controls=controls, control_states=control_states,
+        lazy = PAR.lazy_remap_enabled()
+        if not lazy:
+            _ = qureg.amps  # materialize any perm left by a lazy phase
+        amps = qureg._amps_raw()  # drains any pending fusion first
+        perm = qureg._perm
+        ptargets = qureg._phys_bits(targets)
+        pcontrols = qureg._phys_bits(controls)
+        # recency bookkeeping BEFORE computing the eviction order below:
+        # the current gate's qubits are the hottest
+        for b in (*targets, *controls):
+            qureg._use_clock += 1
+            qureg._last_use[b] = qureg._use_clock
+        high = [t for t in ptargets if t >= nloc]
+        if not high:
+            qureg._set_amps_permuted(
+                K.apply_matrix(
+                    amps, stacked, num_qubits=n,
+                    targets=ptargets, controls=pcontrols,
+                    control_states=control_states),
+                perm)
+            return
+        if len(ptargets) == 1:
+            qureg._set_amps_permuted(
+                PAR.apply_matrix_1q_sharded(
+                    amps, stacked, mesh=env.mesh, num_qubits=n,
+                    target=ptargets[0], controls=pcontrols,
+                    control_states=control_states),
+                perm)
+            return
+        # evict least-recently-used residents: order the free pool by the
+        # occupying LOGICAL qubit's last use (never-used first)
+        inv = {p: q for q, p in enumerate(perm)} if perm is not None else None
+        last = qureg._last_use
+        free_order = sorted(
+            range(nloc),
+            key=lambda p: last.get(inv[p] if inv is not None else p, -1))
+        swaps, new_targets = PAR.plan_relocalization(
+            n, nloc, ptargets, pcontrols, free_order=free_order)
+        if swaps is not None:
+            for lo, hi in swaps:
+                amps = PAR.swap_sharded(
+                    amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
                 )
+            amps = K.apply_matrix(
+                amps, stacked, num_qubits=n, targets=new_targets,
+                controls=pcontrols, control_states=control_states,
+            )
+            if not lazy:
                 for lo, hi in reversed(swaps):
                     amps = PAR.swap_sharded(
-                        amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
+                        amps, mesh=env.mesh, num_qubits=n, qb_low=lo,
+                        qb_high=hi
                     )
-                return amps
-    return K.apply_matrix(
-        amps, stacked, num_qubits=n, targets=targets,
+                qureg.amps = amps
+                return
+            # no swap-back: fold the relocation into the permutation
+            newperm = list(perm) if perm is not None else list(range(n))
+            inv = [0] * n
+            for q, p in enumerate(newperm):
+                inv[p] = q
+            for lo, hi in swaps:
+                ql, qh = inv[lo], inv[hi]
+                newperm[ql], newperm[qh] = hi, lo
+                inv[lo], inv[hi] = qh, ql
+            qureg._set_amps_permuted(amps, tuple(newperm))
+            return
+        # not enough free local qubits to relocalize (the reference
+        # REJECTS such ops, QuEST_validation.c:469-471): materialize
+        # canonical order and fall through to GSPMD propagation
+    qureg.amps = K.apply_matrix(
+        qureg.amps, stacked, num_qubits=n, targets=targets,
         controls=controls, control_states=control_states,
     )
 
@@ -498,11 +554,11 @@ def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     stacked = CX.soa(matrix)
     if _fusion.capture_unitary(qureg, stacked, targets, controls, control_states):
         return
-    qureg.amps = _dispatch_matrix(qureg, stacked, targets, controls, control_states)
+    _dispatch_matrix(qureg, stacked, targets, controls, control_states)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
         conj_stacked = np.stack([stacked[0], -stacked[1]])
-        qureg.amps = _dispatch_matrix(
+        _dispatch_matrix(
             qureg,
             conj_stacked,
             tuple(t + sh for t in targets),
@@ -512,25 +568,35 @@ def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
 
 
 def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
+    """Diagonal gates are elementwise in the computational basis, so they
+    run at the PHYSICAL bit positions of a live permutation without any
+    rematerialization (cf. the reference's no-pairing phase kernels,
+    QuEST_cpu.c:3146-3361 — no exchange at any position)."""
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
     stacked = CX.soa(diag)
     if _fusion.capture_diag(qureg, stacked, targets, controls, control_states):
         return
-    qureg.amps = K.apply_diagonal(
-        qureg.amps, stacked, num_qubits=_sv_n(qureg), targets=targets,
-        controls=controls, control_states=control_states,
-    )
+    amps = qureg._amps_raw()  # drains any pending fusion first
+    perm = qureg._perm
+    qureg._set_amps_permuted(
+        K.apply_diagonal(
+            amps, stacked, num_qubits=_sv_n(qureg),
+            targets=qureg._phys_bits(targets),
+            controls=qureg._phys_bits(controls),
+            control_states=control_states,
+        ), perm)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
         conj_stacked = np.stack([stacked[0], -stacked[1]])
-        qureg.amps = K.apply_diagonal(
-            qureg.amps, conj_stacked, num_qubits=_sv_n(qureg),
-            targets=tuple(t + sh for t in targets),
-            controls=tuple(c + sh for c in controls),
-            control_states=control_states,
-        )
+        qureg._set_amps_permuted(
+            K.apply_diagonal(
+                qureg._amps_raw(), conj_stacked, num_qubits=_sv_n(qureg),
+                targets=qureg._phys_bits(tuple(t + sh for t in targets)),
+                controls=qureg._phys_bits(tuple(c + sh for c in controls)),
+                control_states=control_states,
+            ), perm)
 
 
 # ---------------------------------------------------------------------------
@@ -765,20 +831,29 @@ def multiControlledMultiQubitNot(qureg, ctrls, targs) -> None:
 
 
 def _apply_not(qureg, targets, controls, control_states=()):
+    """NOTs are pure index-bit flips, position-independent — like
+    _apply_diag they run at the physical positions of a live
+    permutation."""
     if _fusion.capture_not(qureg, targets, controls, control_states):
         return
-    qureg.amps = K.apply_multi_qubit_not(
-        qureg.amps, num_qubits=_sv_n(qureg), targets=targets,
-        controls=controls, control_states=control_states,
-    )
+    amps = qureg._amps_raw()  # drains any pending fusion first
+    perm = qureg._perm
+    qureg._set_amps_permuted(
+        K.apply_multi_qubit_not(
+            amps, num_qubits=_sv_n(qureg),
+            targets=qureg._phys_bits(targets),
+            controls=qureg._phys_bits(controls),
+            control_states=control_states,
+        ), perm)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
-        qureg.amps = K.apply_multi_qubit_not(
-            qureg.amps, num_qubits=_sv_n(qureg),
-            targets=tuple(t + sh for t in targets),
-            controls=tuple(c + sh for c in controls),
-            control_states=control_states,
-        )
+        qureg._set_amps_permuted(
+            K.apply_multi_qubit_not(
+                qureg._amps_raw(), num_qubits=_sv_n(qureg),
+                targets=qureg._phys_bits(tuple(t + sh for t in targets)),
+                controls=qureg._phys_bits(tuple(c + sh for c in controls)),
+                control_states=control_states,
+            ), perm)
 
 
 def controlledPauliY(qureg: Qureg, controlQubit: int, targetQubit: int) -> None:
@@ -795,9 +870,32 @@ _SWAP_SOA = np.stack([
 
 
 def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
-    """Swap two qubits' amplitudes (QuEST.h:3768)."""
+    """Swap two qubits' amplitudes (QuEST.h:3768).
+
+    On a sharded register under the lazy-permutation scheduler a SWAP is
+    pure relabeling: it folds into the live logical->physical permutation
+    at ZERO data-movement cost (where the reference's distributed
+    statevec_swapQubitAmps exchanges half the state,
+    QuEST_cpu_distributed.c:1397-1436); canonical order rematerializes on
+    the next state read."""
     V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
     if _fusion.capture_unitary(qureg, _SWAP_SOA, (qubit1, qubit2)):
+        qureg.qasm_log.gate("swap", (qubit1,), qubit2)
+        return
+    env = qureg.env
+    ndev = PAR.amp_axis_size(env.mesh) if env.mesh is not None else 1
+    if (PAR.lazy_remap_enabled() and PAR.explicit_dist_enabled()
+            and ndev > 1 and qureg.num_amps_total >= env.num_devices):
+        amps = qureg._amps_raw()
+        n = _sv_n(qureg)
+        perm = list(qureg._perm or range(n))
+        pairs = [(qubit1, qubit2)]
+        if qureg.is_density_matrix:
+            sh = _shift(qureg)
+            pairs.append((qubit1 + sh, qubit2 + sh))
+        for a, b in pairs:
+            perm[a], perm[b] = perm[b], perm[a]
+        qureg._set_amps_permuted(amps, tuple(perm))
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
         return
     qureg.amps = K.swap_qubit_amps(qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
@@ -835,17 +933,25 @@ def multiControlledMultiRotateZ(qureg, controlQubits, targetQubits, angle) -> No
 
 
 def _apply_parity_phase(qureg, angle, qubits, controls, conj=False):
+    # parity phases are index-derived (elementwise): physical positions
+    # of the live permutation, no rematerialization
     a = -angle if conj else angle
-    qureg.amps = K.apply_parity_phase(
-        qureg.amps, a, num_qubits=_sv_n(qureg), qubits=qubits, controls=controls
-    )
+    amps = qureg._amps_raw()  # drains any pending fusion first
+    perm = qureg._perm
+    qureg._set_amps_permuted(
+        K.apply_parity_phase(
+            amps, a, num_qubits=_sv_n(qureg),
+            qubits=qureg._phys_bits(qubits),
+            controls=qureg._phys_bits(controls),
+        ), perm)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
-        qureg.amps = K.apply_parity_phase(
-            qureg.amps, -a, num_qubits=_sv_n(qureg),
-            qubits=tuple(q + sh for q in qubits),
-            controls=tuple(c + sh for c in controls),
-        )
+        qureg._set_amps_permuted(
+            K.apply_parity_phase(
+                qureg._amps_raw(), -a, num_qubits=_sv_n(qureg),
+                qubits=qureg._phys_bits(tuple(q + sh for q in qubits)),
+                controls=qureg._phys_bits(tuple(c + sh for c in controls)),
+            ), perm)
 
 
 def multiRotatePauli(qureg: Qureg, targetQubits, targetPaulis, angle: float) -> None:

@@ -239,10 +239,13 @@ def _mix_kraus(qureg: Qureg, ops, targets) -> None:
         nq = qureg.num_qubits_represented
         nloc = 2 * nq - PAR.num_shard_bits(qureg.env.mesh)
         sv_targets = D.kraus_targets(tuple(targets), nq)
-        if any(t >= nloc for t in sv_targets):
+        # locality is judged at the PHYSICAL positions of the live
+        # permutation — _dispatch_matrix relocalizes lazily from there
+        if any(t >= nloc for t in qureg._phys_bits(sv_targets)):
             sup = D.superoperator_from_kraus(ops)
-            dt = np.float64 if qureg.amps.dtype == jnp.float64 else np.float32
-            qureg.amps = _dispatch_matrix(
+            dt = (np.float64 if np.dtype(qureg.dtype) == np.float64
+                  else np.float32)
+            _dispatch_matrix(
                 qureg, CX.soa(sup).astype(dt), tuple(sv_targets), (), ())
             return
     qureg.amps = D.apply_kraus_map(

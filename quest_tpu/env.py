@@ -14,6 +14,7 @@ collectives ride ICI/DCN instead of MPI.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import os
 from typing import Optional, Sequence
 
@@ -24,6 +25,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from . import rng
 
 AMP_AXIS = "amps"
+
+# --- shard_map compat shim -------------------------------------------------
+# jax >= 0.6 exposes jax.shard_map (kwarg check_vma=); 0.4.x only has
+# jax.experimental.shard_map.shard_map (kwarg check_rep=).  Every module
+# imports shard_map from HERE so the whole package tracks one spelling.
+try:
+    from jax import shard_map as _shard_map_impl  # type: ignore[attr-defined]
+except ImportError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """Version-portable shard_map: forwards ``check_vma`` under whichever
+    name the installed jax accepts (``check_vma`` new, ``check_rep`` old);
+    omitted -> the jax default."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        else:
+            kwargs["check_rep"] = check_vma
+    return _shard_map_impl(f, **kwargs)
 
 
 @dataclasses.dataclass

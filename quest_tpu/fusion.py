@@ -101,12 +101,15 @@ class ChannelItem:
         self.prob = float(prob)
 
 
-def _plan_key(items, nloc: int, sweep_ok: bool):
+def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None):
     """Content key for a fully-concrete item list, or None when any matrix
     is traced/non-numpy.  Matrices in a drain are small (2x2..128x128), so
     hashing their bytes is negligible next to planning them (~0.2 s of
     host work per drain for a 13-qubit noise layer).  Channel items key on
-    (kind, target) only — the probability is a runtime argument."""
+    (kind, target) only — the probability is a runtime argument.  On a
+    sharded register the key also carries the live logical->physical
+    permutation the drain starts from — the same items plan to different
+    windows/remaps under a different starting perm."""
     parts = []
     for it in items:
         if isinstance(it, ChannelItem):
@@ -116,7 +119,7 @@ def _plan_key(items, nloc: int, sweep_ok: bool):
         if not isinstance(m, np.ndarray):
             return None
         parts.append((it.targets, m.dtype.str, m.shape, m.tobytes()))
-    return (nloc, sweep_ok, tuple(parts))
+    return (nloc, sweep_ok, perm0, tuple(parts))
 
 
 def _split_items(items, nloc: int, sweep_ok: bool):
@@ -164,6 +167,49 @@ def _split_items(items, nloc: int, sweep_ok: bool):
     return tuple(program), tuple(arrays)
 
 
+def _item_bits(it) -> tuple:
+    """Logical state-vector bits an item touches (gate targets incl.
+    embedded controls; channel ket + bra bits)."""
+    if isinstance(it, ChannelItem):
+        return (it.target, it.bra)
+    return tuple(it.targets)
+
+
+def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
+    """Windows + ONE batched remap each for a SHARDED drain: group
+    consecutive items whose cumulative qubit set fits the shard-local
+    space (circuit.plan_remap_windows), emit a ("remap", sigma) part
+    bringing the window's qubits local, then rewrite the window's items
+    to their physical bits and fold them with the ordinary local planner.
+    The permutation persists across windows AND drains — no swap-back;
+    canonical order rematerializes on the next state read (Qureg.amps).
+    Returns (program, arrays, final_perm)."""
+    segments, final_perm = C.plan_remap_windows(
+        [_item_bits(it) for it in items], n, nloc, perm0)
+    program: List[tuple] = []
+    arrays: List[object] = []
+    for (i, j), sigma, perm in segments:
+        if sigma is not None:
+            program.append(("remap", sigma))
+        sub = []
+        for it in items[i:j]:
+            if isinstance(it, ChannelItem):
+                pt, pb = perm[it.target], perm[it.bra]
+                # the pair kernels want the ket bit below the bra bit;
+                # both channel kinds are (t, b)-symmetric (their weights
+                # depend only on the two bits' equality pattern), so a
+                # remap that lands the bra below the ket just swaps roles
+                sub.append(ChannelItem(it.kind, min(pt, pb), max(pt, pb),
+                                       it.prob))
+            else:
+                sub.append(C.Gate(tuple(perm[t] for t in it.targets),
+                                  it.mat))
+        p2, a2 = _split_items(sub, nloc, sweep_ok)
+        program.extend(p2)
+        arrays.extend(a2)
+    return tuple(program), tuple(arrays), final_perm
+
+
 def _run(qureg, items) -> None:
     """Plan with the CONCRETE gate matrices (so controlled gates Schmidt-
     decompose to their true rank), then execute the whole item sequence —
@@ -179,23 +225,37 @@ def _run(qureg, items) -> None:
     nloc = n - nsh
     from .ops import fused as _fusedmod
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
-    key = _plan_key(items, nloc, sweep_ok)
+    perm0 = qureg._perm if nsh else None
+    key = _plan_key(items, nloc, sweep_ok, perm0)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
-        program, arrays = hit
+        program, arrays, final_perm = hit
     else:
-        program, arrays = _split_items(items, nloc, sweep_ok)
+        if nsh:
+            program, arrays, final_perm = _split_items_sharded(
+                items, n, nloc, perm0, sweep_ok)
+        else:
+            program, arrays = _split_items(items, nloc, sweep_ok)
+            final_perm = None
         if key is not None:
             if len(_plan_cache) >= _PLAN_CACHE_MAX:
                 _plan_cache.pop(next(iter(_plan_cache)))
-            _plan_cache[key] = (program, arrays)
+            _plan_cache[key] = (program, arrays, final_perm)
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
     runner = _plan_runner(nloc, program,
                           qureg.env.mesh if nsh else None,
                           _fused.matmul_precision_name())
-    # bypass the amps property (which would re-enter drain)
+    # bypass the amps property (which would re-enter drain); the live
+    # permutation the windowed plan leaves behind is carried on the
+    # register — the next drain starts from it, the next READ
+    # rematerializes canonical order (Qureg.amps)
     qureg._amps = runner(qureg._amps, arrays, probs)
+    if nsh:
+        if final_perm is not None and list(final_perm) != list(range(n)):
+            qureg._perm = tuple(final_perm)
+        else:
+            qureg._perm = None
 
 
 @lru_cache(maxsize=256)
@@ -207,6 +267,11 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
     drain."""
     from .ops import density as _density
 
+    if mesh is not None:
+        from .parallel import dist as PAR
+
+        _ndev = PAR.amp_axis_size(mesh)
+
     def _apply(amps, arrays, probs):
         ai = pi = 0
         for part in program:
@@ -216,6 +281,15 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
                     amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
                     nloc, precision=precision)
                 ai += na
+            elif part[0] == "remap":
+                # ONE batched window relocalization (mixed half-shard
+                # swaps + per-shard axis permutation + composed shard
+                # ppermute) — only emitted inside the mesh path's
+                # shard_map body
+                from .parallel import dist as PAR
+                amps = PAR._remap_in_shard(
+                    amps.reshape(2, -1), part[1], nloc, _ndev
+                ).reshape(amps.shape)
             elif part[0] == "chansweep":
                 entries = part[1]
                 from .ops import fused as _fusedmod
@@ -240,10 +314,9 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
     def run(amps, arrays, probs):
         if mesh is None:
             return _apply(amps, arrays, probs)
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from .env import AMP_AXIS
+        from .env import AMP_AXIS, shard_map
 
         def kernel(local, *arrs):
             return _apply(local, arrs[:len(arrays)], arrs[len(arrays):])
@@ -274,9 +347,13 @@ def _shard_bits(qureg) -> int:
 
 def _capturable(qureg, bits) -> bool:
     """Can a dense gate on qubit positions ``bits`` be buffered?  Size-
-    capped, and on a sharded register every bit must be shard-local (the
-    drain then runs the whole plan inside one shard_map; gates touching
-    mesh-coordinate bits fall back to the explicit-distributed path)."""
+    capped; on a sharded register the drain runs the whole plan inside
+    one shard_map, relocalizing gates that touch mesh-coordinate bits at
+    WINDOW granularity through the lazy logical->physical permutation
+    (_split_items_sharded) — one batched remap per window instead of two
+    half-shard exchanges per gate.  Only gates too wide for the
+    shard-local space (or the GSPMD-opt-out mode, which has no remap
+    kernel) fall back to eager execution."""
     buf = getattr(qureg, "_fusion", None)
     if buf is None:
         return False
@@ -284,8 +361,16 @@ def _capturable(qureg, bits) -> bool:
     if len(bits) > FUSION_MAX_GATE_QUBITS:
         return False
     nsh = _shard_bits(qureg)
-    if nsh and max(bits) >= qureg.num_qubits_in_state_vec - nsh:
-        return False
+    if nsh:
+        nloc = qureg.num_qubits_in_state_vec - nsh
+        if len(set(bits)) > nloc:
+            return False
+        if max(bits) >= nloc:
+            from .parallel import dist as PAR
+
+            if not (PAR.explicit_dist_enabled()
+                    and PAR.lazy_remap_enabled()):
+                return False
     return True
 
 

@@ -315,7 +315,14 @@ def make_expec_term_value(dt, n: int, layer, signed_norm):
 # ---------------------------------------------------------------------------
 
 _GATHER_LO_BITS = 12   # lane-axis width of the split gather (4096)
-_DIRECT_MAX_N = 43     # hi-axis iota must stay below 2^31 rows
+# Direct-rotation cap, DERIVED from the gather split and the int32
+# max-index invariant rather than hand-counted: _flip_gather's hi-axis
+# index vector is an int32 iota over 2^(n - _GATHER_LO_BITS) rows, so its
+# largest value 2^(n - _GATHER_LO_BITS) - 1 must fit int32 — at most 31
+# hi bits on top of the lane split.
+_DIRECT_MAX_N = _GATHER_LO_BITS + 31
+assert (1 << (_DIRECT_MAX_N - _GATHER_LO_BITS)) - 1 <= 2**31 - 1, (
+    "_DIRECT_MAX_N violates the int32 row-index invariant")
 
 
 def _direct_masks(codes, nq: int, offset: int, n: int):
@@ -474,22 +481,17 @@ def _pl_rotation_kernel(meta, fvals, x_ref, f_ref, srow_ref, slane_ref,
 
 def _pl_expec_kernel(meta, fvals, x_ref, f_ref, srow_ref, slane_ref,
                      out_ref):
-    """Per-term expectation contribution Re <x| c P |x> accumulated
-    across the sequential grid: flip (same permutation algebra as the
-    rotation kernel) + sign + product-reduce, one HBM pass."""
-    import jax.experimental.pallas as pl
-    from jax import lax
-
-    i = pl.program_id(0)
+    """Per-term expectation contribution Re <x| P |x>: flip (same
+    permutation algebra as the rotation kernel) + sign + product-reduce,
+    one HBM pass — emitting ONE PARTIAL PER GRID BLOCK.  The (G,)
+    partials are tree-reduced OUTSIDE the kernel (_expec_term_pallas):
+    chaining every block through a single f32 accumulator cell makes the
+    rounding error grow linearly in the block count and loses
+    cross-block cancellation exactly where terms with opposing signs
+    should cancel (ADVICE r5)."""
     x, pr, pi = _pl_flip_signed(meta, fvals, x_ref, f_ref, srow_ref,
                                 slane_ref)
-    partial = jnp.sum(x[0] * pr + x[1] * pi).reshape(1, 1)
-
-    @pl.when(i == 0)
-    def _():
-        out_ref[...] = jnp.zeros((1, 1), x.dtype)
-
-    out_ref[...] += partial
+    out_ref[...] = jnp.sum(x[0] * pr + x[1] * pi).reshape(1, 1)
 
 
 def _pl_term_inputs(amps, codes, ang, nq: int, offset: int, n: int,
@@ -539,7 +541,10 @@ def _pl_grid_spec(R, out_blockspec):
 
 
 def _expec_term_pallas(amps, codes, n: int):
-    """Re <amps| P |amps> with a traced code row, one fused HBM pass."""
+    """Re <amps| P |amps> with a traced code row, one fused HBM pass:
+    the kernel writes one partial per grid block and the (G,) partials
+    tree-reduce here under XLA — O(log G) error depth instead of the
+    former single-cell sequential accumulation's O(G)."""
     import jax
     import jax.experimental.pallas as pl
 
@@ -551,11 +556,11 @@ def _expec_term_pallas(amps, codes, n: int):
     out = pl.pallas_call(
         _pl_expec_kernel,
         grid_spec=_pl_grid_spec(
-            R, pl.BlockSpec((1, 1), lambda i, meta: (0, 0))),
-        out_shape=jax.ShapeDtypeStruct((1, 1), view.dtype),
+            R, pl.BlockSpec((1, 1), lambda i, meta: (i, 0))),
+        out_shape=jax.ShapeDtypeStruct((R // _PL_BR, 1), view.dtype),
         interpret=_fused._interpret_default(),
     )(meta, fvals, view, view, s_row, s_lane)
-    return out[0, 0]
+    return jnp.sum(out)
 
 
 def _direct_rotation_pallas(amps, codes, ang, nq: int, offset: int,

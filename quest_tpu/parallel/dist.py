@@ -44,12 +44,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-from ..env import AMP_AXIS
+from ..env import AMP_AXIS, shard_map
 from ..ops import cplx, kernels
 
-_CONFIG = {"explicit": True}
+_CONFIG = {"explicit": True, "lazy_remap": True}
 
 
 def use_explicit_dist(enabled: bool) -> None:
@@ -59,6 +58,19 @@ def use_explicit_dist(enabled: bool) -> None:
 
 def explicit_dist_enabled() -> bool:
     return _CONFIG["explicit"]
+
+
+def use_lazy_remap(enabled: bool) -> None:
+    """Toggle the communication-avoiding lazy logical->physical
+    permutation (mpiQulacs-style, arXiv:2203.16044).  Disabled, every
+    sharded-target relocalization swaps back eagerly (the reference's
+    per-gate scheme, QuEST_cpu_distributed.c:1447-1545) — kept for A/B
+    benchmarking (bench_suite dist_remap config) and bit-identity tests."""
+    _CONFIG["lazy_remap"] = bool(enabled)
+
+
+def lazy_remap_enabled() -> bool:
+    return _CONFIG["lazy_remap"]
 
 
 def amp_axis_size(mesh: Mesh) -> int:
@@ -814,16 +826,190 @@ def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
     )(amps)
 
 
+# ---------------------------------------------------------------------------
+# Lazy logical->physical qubit remapping (communication avoidance)
+#
+# mpiQulacs (arXiv:2203.16044) and qHiPSTER (arXiv:1601.07195) both amortize
+# the distributed simulator's dominant cost — relocalizing sharded target
+# qubits — with circuit-level qubit reordering: the state is kept in a
+# PERMUTED physical order, later gate targets are rewritten through the live
+# permutation, and data only moves when an upcoming window of gates needs a
+# different set of local qubits.  The kernels below implement the batched
+# exchange realizing one permutation step; quest_tpu.qureg carries the
+# logical->physical map (Qureg._perm) and rematerializes canonical order
+# lazily on the first state read.
+# ---------------------------------------------------------------------------
+
+
+def decompose_sigma(sigma: Tuple[int, ...], nloc: int, r: int):
+    """Split a physical bit permutation (``sigma[p]`` = destination
+    position of the bit currently at physical position ``p``) into the
+    cheapest exchange classes — the class folding of _reverse_run_sharded
+    generalized from bit reversals to arbitrary permutations:
+
+      * mixed  : one (local_bit, mesh_bit) transposition per local<->mesh
+        boundary crossing, each ONE half-shard ppermute (the swap_sharded
+        exchange — only the mismatched half moves);
+      * local  : everything left on the local side, ONE per-shard axis
+        permutation (a permute_qubits arg: out bit q <- in bit perm[q]);
+      * mesh   : everything left on the mesh side, ONE composed full-shard
+        ppermute (``mesh_tau[b]`` = destination mesh bit of coordinate
+        bit b).
+
+    Returns (mixed, local_perm | None, mesh_tau | None), applied in that
+    order."""
+    n = nloc + r
+    cur = list(sigma)
+    assert sorted(cur) == list(range(n)), sigma
+    mixed = []
+    from_local = [p for p in range(nloc) if cur[p] >= nloc]
+    from_mesh = {p for p in range(nloc, n) if cur[p] < nloc}
+    assert len(from_local) == len(from_mesh)
+    for l in from_local:
+        # pair each crossing local bit with its DESTINATION mesh slot when
+        # that slot itself crosses down — a transposition sigma (the window
+        # planner's output) then decomposes into pure mixed swaps with no
+        # residual composed mesh permute
+        m = cur[l] if cur[l] in from_mesh else min(from_mesh)
+        from_mesh.discard(m)
+        mixed.append((l, m - nloc))
+        cur[l], cur[m] = cur[m], cur[l]
+    local_perm = None
+    if cur[:nloc] != list(range(nloc)):
+        inv = [0] * nloc
+        for p in range(nloc):
+            inv[cur[p]] = p
+        local_perm = tuple(inv)
+    mesh_tau = None
+    tau = [cur[nloc + b] - nloc for b in range(r)]
+    if tau != list(range(r)):
+        mesh_tau = tuple(tau)
+    return tuple(mixed), local_perm, mesh_tau
+
+
+def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int):
+    """Apply the physical bit permutation ``sigma`` INSIDE a shard_map
+    body: the mixed half-shard swaps, then one per-shard axis permutation,
+    then one composed shard-index ppermute (decompose_sigma).  Shared by
+    the standalone remap_sharded program and the fusion drain's
+    ("remap", sigma) parts."""
+    r = int(math.log2(ndev))
+    mixed, local_perm, mesh_tau = decompose_sigma(sigma, nloc, r)
+    for lb, mb in mixed:
+        idx = lax.axis_index(AMP_AXIS)
+        u = (idx >> mb) & 1
+        lv = local.reshape(2, 1 << (nloc - 1 - lb), 2, 1 << lb)
+        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
+        recv = lax.ppermute(send, AMP_AXIS, _hypercube_perm(ndev, mb))
+        local = lax.dynamic_update_index_in_dim(
+            lv, recv, 1 - u, axis=2).reshape(2, -1)
+    if local_perm is not None:
+        local = kernels.permute_qubits(local, num_qubits=nloc,
+                                       perm=local_perm)
+    if mesh_tau is not None:
+        def dest(i):
+            j = 0
+            for b, t in enumerate(mesh_tau):
+                j |= ((i >> b) & 1) << t
+            return j
+
+        local = lax.ppermute(local, AMP_AXIS,
+                             [(i, dest(i)) for i in range(ndev)])
+    return local
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "sigma"),
+         donate_argnums=0)
+def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
+                  sigma: Tuple[int, ...]):
+    """ONE batched physical-bit permutation of a sharded register: at most
+    (#local<->mesh crossings) half-shard ppermutes + one per-shard axis
+    permutation + one composed full-shard ppermute, regardless of how many
+    gates the window it serves contains.  This is the communication the
+    window planner schedules ONCE per window where the reference pays two
+    half-shard exchanges per sharded-target gate
+    (QuEST_cpu_distributed.c:1447-1545)."""
+    ndev = amp_axis_size(mesh)
+    r = num_shard_bits(mesh)
+    nloc = num_qubits - r
+
+    def kernel(local):
+        return _remap_in_shard(local, sigma, nloc, ndev)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=P(None, AMP_AXIS),
+        out_specs=P(None, AMP_AXIS), check_vma=False,
+    )(amps)
+
+
+def canonical_sigma(perm: Tuple[int, ...]) -> Tuple[int, ...]:
+    """The physical permutation rematerializing canonical order from a
+    live logical->physical ``perm`` (sigma = perm^-1: the bit at physical
+    perm[q] returns to position q)."""
+    sigma = [0] * len(perm)
+    for q, p in enumerate(perm):
+        sigma[p] = q
+    return tuple(sigma)
+
+
+def plan_window_remap(num_qubits: int, nloc: int, perm: Tuple[int, ...],
+                      want_local, next_use=None):
+    """Choose the minimal-movement permutation making every logical qubit
+    in ``want_local`` shard-local: qubits already local stay put; each
+    sharded one swaps with the local slot whose resident logical qubit is
+    needed FURTHEST in the future (``next_use``: logical qubit -> distance
+    of its next use; absent = never used again, evicted first — the same
+    lookahead policy as the paged planner's eviction choice).
+
+    Returns (sigma | None, new_perm): ``sigma`` is None when nothing
+    moves; (None, None) when ``want_local`` exceeds the local capacity —
+    the caller must split the window."""
+    n = num_qubits
+    perm = list(perm)
+    want_local = sorted(set(want_local))
+    if len(want_local) > nloc:
+        return None, None
+    inv = [0] * n
+    for q, p in enumerate(perm):
+        inv[p] = q
+    need = [q for q in want_local if perm[q] >= nloc]
+    if not need:
+        return None, tuple(perm)
+    wanted = set(want_local)
+    pool = [p for p in range(nloc) if inv[p] not in wanted]
+    assert len(pool) >= len(need)  # guaranteed by |want_local| <= nloc
+    if next_use is None:
+        next_use = {}
+    pool.sort(key=lambda p: next_use.get(inv[p], 1 << 60), reverse=True)
+    sigma = list(range(n))
+    for q in need:
+        p_high = perm[q]
+        p_slot = pool.pop(0)
+        q_evicted = inv[p_slot]
+        sigma[p_slot], sigma[p_high] = p_high, p_slot
+        perm[q], perm[q_evicted] = p_slot, p_high
+        inv[p_slot], inv[p_high] = q, q_evicted
+    return tuple(sigma), tuple(perm)
+
+
 def plan_relocalization(
     num_qubits: int,
     nloc: int,
     targets: Tuple[int, ...],
     controls: Tuple[int, ...] = (),
+    free_order=None,
 ):
     """Choose swap pairs pulling every sharded target down to a free local
     qubit (reference picks the lowest free qubit and patches the control
     mask on collision, QuEST_cpu_distributed.c:1508-1531; we instead exclude
     controls from the free pool so the mask never needs patching).
+
+    ``free_order``: optional eviction-preference ordering of the local
+    slots (coldest first) — under the lazy permutation the dispatch layer
+    passes a least-recently-used ordering so a relocation never evicts the
+    qubits the circuit is actively using (the ping-pong that would
+    otherwise re-pay the exchange every alternation); default is the
+    reference's lowest-first choice.
 
     Returns (swaps, new_targets), or (None, None) when there aren't enough
     free local qubits — the caller falls back to the GSPMD path (the
@@ -831,7 +1017,8 @@ def plan_relocalization(
     QuEST_validation.c:469-471, so this is strictly more capable)."""
     targets = list(targets)
     blocked = set(targets) | set(controls)
-    free_local = [q for q in range(nloc) if q not in blocked]
+    order = free_order if free_order is not None else range(nloc)
+    free_local = [q for q in order if q not in blocked]
     swaps = []
     for i, t in enumerate(targets):
         if t >= nloc:

@@ -90,7 +90,9 @@ def validation_eps() -> float:
     reference's quad mode validates in long double, where 1e-14 is
     comfortable — here a valid matrix can sit at the f64 rounding floor
     and 1e-14 would falsely reject it; ADVICE r4).  The tightened 1e-14
-    is reserved for the compensated-reduction outputs."""
+    is reserved for the compensated-reduction outputs.  This deliberate
+    divergence is documented user-facing in docs/design.md §15 and the
+    README precision section."""
     return _REAL_EPS[min(_state.quest_prec, 2)]
 
 

@@ -47,6 +47,19 @@ class Qureg:
         self.qasm_log = QASMLogger(num_qubits)
         self._amps: Optional[jax.Array] = None
         self._fusion = None  # FusionBuffer while a gateFusion context is active
+        # live logical->physical qubit permutation of a SHARDED register
+        # (None = canonical order).  _perm[q] = physical state-vector bit
+        # holding logical bit q: the communication-avoiding scheduler keeps
+        # the state permuted across windows and only rematerializes
+        # canonical order on a state read (the ``amps`` getter below) —
+        # see parallel/dist.py remap_sharded.
+        self._perm: Optional[tuple] = None
+        # last-use tick per logical state-vector bit: the relocalizer
+        # evicts the least-recently-used residents so an alternating
+        # circuit never ping-pongs its hot qubits across the shard
+        # boundary
+        self._last_use: dict = {}
+        self._use_clock: int = 0
 
     # -- reference-parity metadata (QuEST.h:330-345) --
     @property
@@ -63,6 +76,11 @@ class Qureg:
 
     @property
     def amps(self) -> jax.Array:
+        """Amplitudes in CANONICAL qubit order: pending fused gates drain
+        first, then a live logical->physical permutation (left behind by
+        the communication-avoiding scheduler) is rematerialized with ONE
+        batched remap — so every reader (calculations, measurement,
+        checkpointing, host gathers) sees reference semantics."""
         if self._amps is None:
             from . import validation
 
@@ -73,7 +91,15 @@ class Qureg:
         if self._fusion is not None and self._fusion.gates:
             from . import fusion
 
-            fusion.drain(self)
+            fusion.drain(self)  # may leave a live permutation
+        if self._perm is not None:
+            from .parallel import dist as PAR
+
+            self._amps = PAR.remap_sharded(
+                self._amps, mesh=self.env.mesh,
+                num_qubits=self.num_qubits_in_state_vec,
+                sigma=PAR.canonical_sigma(self._perm))
+            self._perm = None
         return self._amps
 
     @amps.setter
@@ -83,7 +109,39 @@ class Qureg:
             # that depended on the old state already drained via the
             # getter) — discard them instead of computing a dead result
             self._fusion.gates.clear()
+        # external overwrites are canonical-order by contract; only the
+        # perm-aware writers (_set_amps_permuted) carry a permutation over
+        self._perm = None
         self._amps = value
+
+    def _amps_raw(self) -> jax.Array:
+        """Amplitudes WITHOUT rematerializing canonical order — the
+        perm-aware dispatch path's read (pending fused gates still drain
+        first so operation order is preserved)."""
+        if self._amps is None:
+            return self.amps  # raises the destroyed-register error
+        if self._fusion is not None and self._fusion.gates:
+            from . import fusion
+
+            fusion.drain(self)
+        return self._amps
+
+    def _set_amps_permuted(self, value: jax.Array, perm) -> None:
+        """Rebind amplitudes held under logical->physical ``perm``
+        (identity or None -> canonical).  Unlike the ``amps`` setter this
+        PRESERVES the lazy-permutation bookkeeping."""
+        self._amps = value
+        if perm is not None and tuple(perm) == tuple(
+                range(self.num_qubits_in_state_vec)):
+            perm = None
+        self._perm = None if perm is None else tuple(perm)
+
+    def _phys_bits(self, bits) -> tuple:
+        """Physical positions of logical state-vector bits under the live
+        permutation (identity when none is active)."""
+        if self._perm is None:
+            return tuple(bits)
+        return tuple(self._perm[b] for b in bits)
 
     def sharding(self):
         if self.num_amps_total >= self.env.num_devices:

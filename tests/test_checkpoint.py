@@ -67,6 +67,60 @@ class TestCSVRoundtrip:
         q = qt.createQureg(3, env)
         assert not qt.initStateFromSingleFile(q, str(path), env)
 
+    def test_malformed_mid_stream_leaves_state_untouched(self, env, tmp_path):
+        """The streamed reader only rebinds the register on full success
+        — a bad line after some good ones must not corrupt the state."""
+        path = tmp_path / "midbad.csv"
+        path.write_text("0.5, 0.0\n0.5, 0.0\nnot-a-number\n0.5, 0.0\n")
+        q = qt.createQureg(3, env)
+        qt.initDebugState(q)
+        before = np.asarray(q.amps).copy()
+        assert not qt.readStateFromFile(q, str(path))
+        np.testing.assert_allclose(np.asarray(q.amps), before)
+
+    def test_read_streams_past_host_gather_cap(self, env, tmp_path,
+                                               monkeypatch):
+        """ADVICE r5: writeStateToFile streams any size to disk, and the
+        streamed reader must load those files back — round-trip symmetry.
+        Pin the message cap below the register size: the old reader
+        hard-failed through _guard_host_gather here; the streamed one
+        (tile-aligned ranged setAmps, no full-state host buffer) must
+        succeed."""
+        from quest_tpu import precision
+
+        q = qt.createQureg(5, env)
+        qt.initDebugState(q)
+        qt.hadamard(q, 1)
+        before = oracle.state_from_qureg(q)
+        path = str(tmp_path / "big.csv")
+        qt.writeStateToFile(q, path)
+        monkeypatch.setitem(precision._MAX_AMPS_IN_MSG,
+                            precision.get_precision(), 4)
+        # the gather-guarded debug paths still refuse...
+        with pytest.raises(qt.QuESTError):
+            qt.compareStates(q, q, 1.0)
+        # ...but the streamed reader round-trips
+        q2 = qt.createQureg(5, env)
+        assert qt.readStateFromFile(q2, path)
+        np.testing.assert_allclose(oracle.state_from_qureg(q2), before,
+                                   atol=1e-12)
+
+    def test_read_multi_chunk_stream(self, env, tmp_path, monkeypatch):
+        """Force several flush chunks through the ranged-write path."""
+        from quest_tpu import checkpoint
+
+        monkeypatch.setattr(checkpoint, "_READ_CHUNK", 8)
+        q = qt.createQureg(5, env)     # 32 amps -> 4 chunks
+        qt.initDebugState(q)
+        qt.rotateY(q, 3, 0.7)
+        before = oracle.state_from_qureg(q)
+        path = str(tmp_path / "chunks.csv")
+        qt.writeStateToFile(q, path)
+        q2 = qt.createQureg(5, env)
+        assert qt.readStateFromFile(q2, path)
+        np.testing.assert_allclose(oracle.state_from_qureg(q2), before,
+                                   atol=1e-12)
+
 
 class TestDebugAPI:
     @pytest.mark.parametrize("qubit,outcome", [(0, 0), (2, 1), (4, 0)])

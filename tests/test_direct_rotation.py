@@ -140,6 +140,54 @@ def test_pallas_expec_matches_gather_form():
     assert abs(got - want) < 1e-12
 
 
+def test_pallas_expec_block_partials_cancel():
+    """ADVICE r5: the expectation kernel emits one partial per grid block
+    and tree-reduces outside — exact cancellation across blocks.  At
+    n = 17 (R/BR = 4 blocks) the uniform state's <Z_top> splits into
+    per-block partials of opposite sign that must cancel to EXACTLY zero
+    (the former single-cell sequential accumulation only cancelled up to
+    its chained rounding); a two-term sum with opposing coefficients on
+    the same string must cancel exactly as well."""
+    n = 17
+    dim = 1 << n
+    a = jnp.full((2, dim), 0.0).at[0, :].set(1.0 / np.sqrt(dim))
+    # Z on the top qubit: + on the low half, - on the high half
+    z_top = jnp.asarray([[0] * (n - 1) + [3]], jnp.int32)
+
+    @jax.jit
+    def one_term(av):
+        return P._expec_term_pallas(av, z_top[0], n)
+
+    assert float(one_term(a)) == 0.0
+    # cancelling coefficients on an identical random string
+    rng = np.random.default_rng(5)
+    row = jnp.asarray(rng.integers(0, 4, size=n), jnp.int32)
+    v = rng.standard_normal((2, dim)).astype(np.float64)
+    v /= np.sqrt((v ** 2).sum())
+    av = jnp.asarray(v)
+
+    @jax.jit
+    def two_terms(av):
+        t = P._expec_term_pallas(av, row, n)
+        return 1.0 * t + (-1.0) * t
+
+    assert float(two_terms(av)) == 0.0
+    # and the per-block form still equals the gather form on a dense state
+    got = float(jax.jit(one_term)(av))
+    pv, _ = P._apply_pauli_traced(av, z_top[0], n, 0, n, conj=False)
+    want = float(jnp.sum(av[0] * pv[0] + av[1] * pv[1]))
+    assert abs(got - want) < 1e-12
+
+
+def test_direct_max_n_derived_from_gather_split():
+    """ADVICE r5: the direct-rotation cap is derived from the gather
+    split width and the int32 max-index invariant, not hand-counted."""
+    assert P._DIRECT_MAX_N == P._GATHER_LO_BITS + 31
+    rows = 1 << (P._DIRECT_MAX_N - P._GATHER_LO_BITS)
+    assert rows - 1 <= np.iinfo(np.int32).max
+    assert 2 * rows - 1 > np.iinfo(np.int32).max  # the cap is tight
+
+
 def test_cpu_routing_prefers_gather():
     """Off-TPU the production scans must not route the interpreted
     Pallas grid (hundreds of sequential interpreted steps per term)."""

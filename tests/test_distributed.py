@@ -32,8 +32,12 @@ def _rand_psi(env, rng, n=N):
 def test_sharding_layout(env):
     q = qt.createQureg(N, env)
     assert q.num_chunks == env.num_devices
-    # amps live sharded over the amp axis
-    shardings = {tuple(s.index) for s in q.amps.addressable_shards}
+    # amps live sharded over the amp axis (slices are unhashable before
+    # py3.12 — key on their bounds)
+    shardings = {
+        tuple((sl.start, sl.stop) for sl in s.index)
+        for s in q.amps.addressable_shards
+    }
     assert len(shardings) == env.num_devices
 
 

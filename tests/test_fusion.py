@@ -254,17 +254,22 @@ class TestShardedFusion:
         np.testing.assert_allclose(got, np.asarray(extra.amps), atol=1e-6)
         assert abs(qt.calcTotalProb(q1) - 1.0) < 1e-5
 
-    def test_global_bit_gate_not_buffered(self):
+    def test_global_bit_gate_buffers_through_lazy_remap(self):
+        """A gate on a mesh-coordinate bit now BUFFERS too: the drain
+        relocalizes it at window granularity through the lazy
+        logical->physical permutation instead of bailing to the eager
+        per-gate path (the communication-avoiding scheduler)."""
         env8 = qt.createQuESTEnv()
         q = qt.createQureg(17, env8)
         qt.initZeroState(q)
         with qt.gateFusion(q):
             qt.hadamard(q, 2)
             assert len(q._fusion.gates) == 1
-            qt.hadamard(q, 15)   # >= nloc: drains, runs eagerly
-            assert len(q._fusion.gates) == 0
+            qt.hadamard(q, 15)   # >= nloc: stays buffered
+            assert len(q._fusion.gates) == 2
         assert abs(qt.calcProbOfOutcome(q, 15, 0) - 0.5) < 1e-6
         assert abs(qt.calcProbOfOutcome(q, 2, 0) - 0.5) < 1e-6
+        assert q._perm is None  # the read rematerialized canonical order
 
 
 class TestChannelCapture:
@@ -354,9 +359,12 @@ class TestChannelCapture:
         np.testing.assert_allclose(np.asarray(fused.amps),
                                    np.asarray(eager.amps), atol=1e-12)
 
-    def test_sharded_bra_bit_channel_falls_back(self):
-        """A channel whose bra bit is a mesh coordinate drains the buffer
-        and takes the explicit-distributed path, preserving order."""
+    def test_sharded_bra_bit_channel_captured_via_remap(self):
+        """A channel whose bra bit is a mesh coordinate is now CAPTURED:
+        the drain's window remap pulls the bra bit shard-local (the pair
+        kernel runs at the permuted positions — both channel kinds are
+        (t, b)-symmetric) and the result matches the eager
+        explicit-distributed path."""
         env8 = qt.createQuESTEnv()
         if env8.num_devices < 8:
             pytest.skip("needs 8 virtual devices")
@@ -366,7 +374,7 @@ class TestChannelCapture:
         with qt.gateFusion(fused):
             qt.hadamard(fused, 0)
             qt.mixDepolarising(fused, 6, 0.2)   # bra bit 13 >= nloc=11
-            assert not fused._fusion.gates      # drained + eager
+            assert len(fused._fusion.gates) == 3  # H + bra twin + channel
         eager = qt.createDensityQureg(n, env8)
         qt.initPlusState(eager)
         qt.hadamard(eager, 0)
