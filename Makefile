@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify bench docs clean
+.PHONY: all native test verify verify-faults bench docs clean
 
 all: native
 
@@ -27,6 +27,12 @@ test: native
 # marker, collection errors surfaced, pass count echoed.
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Fault-injection / resilience suite (tests marked `faults`): simulated
+# preemptions, mid-save kills, corrupt checkpoints, transient IO errors,
+# NaN injection + watchdog policies (quest_tpu/resilience.py).
+verify-faults:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults -p no:cacheprovider -p no:xdist -p no:randomly
 
 bench: native
 	python bench.py

@@ -43,6 +43,15 @@ from .checkpoint import (
     writeStateToFile,
     readStateFromFile,
 )
+from .resilience import (
+    run_resumable as runResumable,
+    run_resumable,
+    check_qureg_health as checkQuregHealth,
+    FaultPlan,
+    SimulatedPreemption,
+    NumericalHealthError,
+    degradation_report,
+)
 from .debug import (
     initStateOfSingleQubit,
     initStateFromSingleFile,

@@ -281,6 +281,8 @@ def initDiagonalOp(op: DiagonalOp, reals, imags) -> None:
     rdt = real_dtype()
     dim = 1 << op.num_qubits
     sharding = op.env.sharding_for_dim(dim)
+    V.validate_finite(np.asarray(reals), "initDiagonalOp")
+    V.validate_finite(np.asarray(imags), "initDiagonalOp")
     op.real = jax.device_put(jnp.asarray(np.asarray(reals), rdt), sharding)
     op.imag = jax.device_put(jnp.asarray(np.asarray(imags), rdt), sharding)
 
@@ -290,6 +292,8 @@ def setDiagonalOpElems(op: DiagonalOp, startInd: int, reals, imags, numElems: in
     reals = np.asarray(reals, dtype=np.float64)[:numElems]
     imags = np.asarray(imags, dtype=np.float64)[:numElems]
     V.validate_num_elems(op, startInd, numElems, "setDiagonalOpElems")
+    V.validate_finite(reals, "setDiagonalOpElems")
+    V.validate_finite(imags, "setDiagonalOpElems")
     op.real = op.real.at[startInd:startInd + numElems].set(reals.astype(op.real.dtype))
     op.imag = op.imag.at[startInd:startInd + numElems].set(imags.astype(op.imag.dtype))
 
@@ -399,6 +403,8 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
     im = np.asarray(imags, dtype=np.float64).ravel()
     if re.size != qureg.num_amps_total or im.size != qureg.num_amps_total:
         raise V.QuESTError("initStateFromAmps: Incorrect number of amplitudes.")
+    V.validate_finite(re, "initStateFromAmps")
+    V.validate_finite(im, "initStateFromAmps")
     qureg.amps = qureg.device_put(np.stack([re, im]))
 
 
@@ -412,6 +418,8 @@ def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
     im = np.asarray(imags, dtype=np.float64).ravel()[:numAmps]
     if re.size != numAmps or im.size != numAmps:
         raise V.QuESTError("setAmps: Incorrect number of amplitudes.")
+    V.validate_finite(re, "setAmps")
+    V.validate_finite(im, "setAmps")
     vals = np.stack([re, im]).astype(qureg.dtype)
     # layout-safe ranged write: tile-aligned block updates + edge tiles,
     # never the eager .at[].set() whose gather relayouts a canonically-
@@ -424,6 +432,8 @@ def setDensityAmps(qureg: Qureg, reals, imags) -> None:
     V.validate_density_matrix(qureg, "setDensityAmps")
     re = np.asarray(reals, dtype=np.float64).ravel()
     im = np.asarray(imags, dtype=np.float64).ravel()
+    V.validate_finite(re, "setDensityAmps")
+    V.validate_finite(im, "setDensityAmps")
     qureg.amps = qureg.device_put(np.stack([re, im]))
 
 

@@ -13,6 +13,7 @@ reference-format compatibility.
 from __future__ import annotations
 
 import json
+import math
 import os
 
 import jax
@@ -32,47 +33,122 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _qureg_meta(qureg: Qureg) -> dict:
+    """Base register metadata (the resilience layer extends it with a
+    circuit cursor, the live permutation, and the RNG state)."""
+    from . import precision
+
+    return {
+        "num_qubits_represented": qureg.num_qubits_represented,
+        "is_density_matrix": qureg.is_density_matrix,
+        "dtype": str(np.dtype(qureg.dtype)),
+        "precision": precision.get_precision(),
+    }
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    tmp = os.path.join(path, _META_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(path, _META_NAME))
+
+
+def _read_meta(path: str) -> dict:
+    meta_path = os.path.join(path, _META_NAME)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no qureg checkpoint at {path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if not isinstance(meta, dict) or "num_qubits_represented" not in meta:
+        raise ValueError(f"malformed checkpoint metadata at {meta_path}")
+    return meta
+
+
+def _qureg_from_meta(meta: dict, env: QuESTEnv) -> Qureg:
+    """Build the target register for a restore, validating the checkpoint
+    against THIS env up front — a precision or shardability mismatch must
+    surface as a structured QuESTError naming both sides, not as an orbax
+    resharding failure deep inside the restore."""
+    from . import precision
+
+    ck_dtype = np.dtype(meta["dtype"])
+    env_dtype = precision.real_dtype()
+    if ck_dtype != np.dtype(env_dtype):
+        raise QuESTError(
+            "loadQureg: checkpoint precision mismatch — the checkpoint "
+            f"was written at dtype {ck_dtype} (precision "
+            f"{meta.get('precision', '?')}) but this environment runs at "
+            f"dtype {np.dtype(env_dtype)} (precision "
+            f"{precision.get_precision()}); call set_precision to match "
+            "before loading"
+        )
+    q = Qureg(meta["num_qubits_represented"], env, meta["is_density_matrix"])
+    if q.num_amps_total < env.num_devices:
+        raise QuESTError(
+            "loadQureg: the mesh has grown past the register's shardable "
+            f"size — the checkpoint holds {q.num_amps_total} amplitudes "
+            f"({meta['num_qubits_represented']} qubits, density="
+            f"{meta['is_density_matrix']}) but this environment has "
+            f"{env.num_devices} devices; load on a mesh with at most "
+            f"{q.num_amps_total} devices"
+        )
+    q.dtype = ck_dtype
+    return q
+
+
+def _restore_amps(path: str, q: Qureg):
+    """Restore the amplitude payload for ``q`` from ``path`` (transient IO
+    errors retried with bounded exponential backoff)."""
+    from . import resilience
+
+    ckpt = _checkpointer()
+    target = jax.ShapeDtypeStruct(
+        (2, q.num_amps_total), q.dtype, sharding=q.sharding()
+    )
+    restored = resilience.retry_io(
+        ckpt.restore, os.path.join(path, _AMPS_NAME), {"amps": target},
+        what="loadQureg(amps)")
+    return restored["amps"]
+
+
 def saveQureg(qureg: Qureg, path: str) -> None:
     """Write a durable snapshot of ``qureg`` (amps + metadata) at ``path``.
 
     Works for state-vectors and density matrices, any sharding; the write
-    is atomic at the directory level (orbax finalization)."""
+    is atomic at the directory level (orbax finalization), and transient
+    IO errors are retried with bounded exponential backoff
+    (resilience.retry_io).  Amplitudes are written in CANONICAL qubit
+    order (any live permutation rematerializes first); the resilience
+    layer's generation protocol (resilience.save_generation) instead
+    snapshots the raw permuted state for bit-exact mid-circuit resume."""
+    from . import resilience
+
     path = os.path.abspath(path)
     ckpt = _checkpointer()
-    ckpt.save(os.path.join(path, _AMPS_NAME), {"amps": qureg.amps}, force=True)
-    ckpt.wait_until_finished()
-    meta = {
-        "num_qubits_represented": qureg.num_qubits_represented,
-        "is_density_matrix": qureg.is_density_matrix,
-        "dtype": str(np.dtype(qureg.dtype)),
-    }
-    with open(os.path.join(path, _META_NAME), "w") as f:
-        json.dump(meta, f)
+    resilience.retry_io(
+        ckpt.save, os.path.join(path, _AMPS_NAME), {"amps": qureg.amps},
+        force=True, what="saveQureg(amps)")
+    resilience.retry_io(ckpt.wait_until_finished, what="saveQureg(wait)")
+    resilience.retry_io(_write_meta, path, _qureg_meta(qureg),
+                        what="saveQureg(meta)")
 
 
 def loadQureg(path: str, env: QuESTEnv) -> Qureg:
     """Restore a register saved by :func:`saveQureg` onto ``env``'s mesh.
 
     The amplitude array is restored directly into the register's current
-    sharding (resharding on the fly if the mesh shape changed)."""
+    sharding (resharding on the fly if the mesh shape changed).  The
+    checkpoint metadata is validated against ``env`` FIRST: a precision
+    mismatch (e.g. written at prec 2, loaded at prec 1) or a mesh grown
+    past the register's shardable size raises a QuESTError naming both
+    sides instead of failing inside orbax resharding."""
     path = os.path.abspath(path)
-    meta_path = os.path.join(path, _META_NAME)
-    if not os.path.exists(meta_path):
+    try:
+        meta = _read_meta(path)
+    except FileNotFoundError:
         raise QuESTError(f"no qureg checkpoint at {path}", "loadQureg")
-    with open(meta_path) as f:
-        meta = json.load(f)
-    q = Qureg(
-        meta["num_qubits_represented"], env, meta["is_density_matrix"]
-    )
-    # restore in the checkpoint's dtype and keep the register
-    # self-consistent even if the global precision changed since save
-    q.dtype = np.dtype(meta["dtype"])
-    ckpt = _checkpointer()
-    target = jax.ShapeDtypeStruct(
-        (2, q.num_amps_total), np.dtype(meta["dtype"]), sharding=q.sharding()
-    )
-    restored = ckpt.restore(os.path.join(path, _AMPS_NAME), {"amps": target})
-    q.amps = restored["amps"]
+    q = _qureg_from_meta(meta, env)
+    q.amps = _restore_amps(path, q)
     return q
 
 
@@ -133,10 +209,13 @@ def readStateFromFile(qureg: Qureg, filename: str) -> bool:
     Streams the file in tile-aligned chunks through ranged device writes
     (element.set_amp_range) into a fresh device-side buffer — the
     register is only rebound on full success, so failure semantics are
-    unchanged (malformed/truncated file leaves the state untouched).
-    No full-state host buffer is ever built, restoring round-trip
-    symmetry with the streamed writeStateToFile: any state that module
-    can dump, this can load (the old path hard-failed via
+    unchanged (malformed/truncated/garbage file leaves the state
+    untouched — the stream writes into a fresh device buffer, never the
+    live register).  Non-finite values (NaN/Inf — a torn write or bit
+    rot, never a legal amplitude) are rejected like any other parse
+    failure.  No full-state host buffer is ever built, restoring
+    round-trip symmetry with the streamed writeStateToFile: any state
+    that module can dump, this can load (the old path hard-failed via
     _guard_host_gather beyond the message cap — ADVICE r5)."""
     import jax.numpy as jnp
 
@@ -159,7 +238,10 @@ def readStateFromFile(qureg: Qureg, filename: str) -> bool:
                 if written + fill >= total:
                     break
                 parts = line.split(",")
-                buf[0, fill], buf[1, fill] = float(parts[0]), float(parts[1])
+                re, im = float(parts[0]), float(parts[1])
+                if not (math.isfinite(re) and math.isfinite(im)):
+                    return False
+                buf[0, fill], buf[1, fill] = re, im
                 fill += 1
                 if fill == _READ_CHUNK:
                     work = element.set_amp_range(work, written,

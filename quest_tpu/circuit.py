@@ -1380,6 +1380,23 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
     return amps
 
 
+def plan_checkpoint_boundaries(num_gates: int, every: int,
+                               start: int = 0) -> List[int]:
+    """Gate cursors where a resumable run may checkpoint: every ``every``
+    gates plus the stream end.  Boundaries fall BETWEEN fusion drains —
+    the resilience driver (resilience.run_resumable) opens one fusion
+    window per [boundary, boundary) span, so a checkpoint never lands
+    mid-window and an interrupted run re-plans the identical window
+    sequence on resume (same spans -> same plan-cache keys -> bit-exact
+    replay)."""
+    if every < 1:
+        raise ValueError("plan_checkpoint_boundaries: every must be >= 1")
+    out = list(range(start + every, num_gates, every))
+    if num_gates > start:
+        out.append(num_gates)
+    return out
+
+
 def apply_circuit(amps, gates: Sequence[Gate], num_qubits: int,
                   interpret: Optional[bool] = None):
     """Plan + execute in one call (both happen at trace time under jit)."""

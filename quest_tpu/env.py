@@ -206,12 +206,21 @@ def report_quest_env(env: QuESTEnv) -> None:
 
 def get_environment_string(env: QuESTEnv) -> str:
     """getEnvironmentString (QuEST.h:1912) — reference format:
-    'CUDA=.. OpenMP=.. MPI=.. threads=.. ranks=..'; ours reports the mesh."""
+    'CUDA=.. OpenMP=.. MPI=.. threads=.. ranks=..'; ours reports the mesh,
+    plus any recorded graceful degradations (e.g. a Pallas kernel that
+    failed to lower and fell back to the XLA path — resilience.py)."""
     backend = jax.default_backend()
-    return (
+    s = (
         f"EnvType=quest_tpu Backend={backend} Devices={env.num_devices} "
         f"MeshAxes={AMP_AXIS} Processes={jax.process_count()}"
     )
+    from . import resilience
+
+    degraded = resilience.degradation_report()
+    if degraded:
+        s += " Degraded=[" + "; ".join(
+            f"{k}: {v}" for k, v in sorted(degraded.items())) + "]"
+    return s
 
 
 def seed_quest(env: QuESTEnv, seeds: Sequence[int]) -> None:

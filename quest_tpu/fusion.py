@@ -78,6 +78,9 @@ def drain(qureg) -> None:
         except BaseException:
             buf.gates = gates + buf.gates
             raise
+        # window-boundary accounting for the resilience layer: checkpoint
+        # cadence is asserted against drains, never mid-window
+        qureg._drain_count = getattr(qureg, "_drain_count", 0) + 1
 
 
 _PLAN_CACHE_MAX = 64

@@ -70,6 +70,29 @@ class _KeyState:
         self.counter += count
         return self.key, shot
 
+    # -- state round-trip (resumable execution, resilience.py) --
+
+    def get_state(self) -> dict:
+        """JSON-serializable (key, shot counter) snapshot so the
+        device-side outcome stream resumes exactly where it left off."""
+        key = None
+        if self.key is not None:
+            import numpy as np
+
+            raw = jax.random.key_data(self.key) \
+                if jnp.issubdtype(self.key.dtype, jax.dtypes.prng_key) \
+                else self.key
+            key = [int(x) for x in np.asarray(raw).ravel()]
+        return {"key": key, "counter": int(self.counter)}
+
+    def set_state(self, state: dict) -> None:
+        import numpy as np
+
+        data = state.get("key")
+        self.key = None if data is None else jnp.asarray(
+            np.array(data, dtype=np.uint32))
+        self.counter = int(state.get("counter", 0))
+
 
 KEYS = _KeyState()
 

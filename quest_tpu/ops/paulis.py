@@ -427,10 +427,55 @@ def _direct_rotation(amps, codes, ang, nq: int, offset: int, n: int,
 _PL_BR = 256            # rows per block (n >= _PL_MIN_N so R >= _PL_BR)
 _PL_MIN_N = 15
 
+# one-shot Pallas lowering probe result (None = not yet probed).  A
+# failed probe downgrades the direct-rotation/expectation path to the
+# XLA gather form for the rest of the process — graceful degradation
+# instead of a trace-time crash on a Mosaic/driver regression — and
+# records itself in the env report (resilience.record_degradation).
+_PALLAS_OK: dict = {}
+
+
+def _probe_pallas_lowering() -> None:
+    """Lower (don't run) a minimal rotation-kernel pallas_call at the
+    smallest routable size; raises on any Mosaic/lowering failure."""
+    probe_n = _PL_MIN_N
+    amps = jax.ShapeDtypeStruct((2, 1 << probe_n), jnp.float32)
+    codes = jax.ShapeDtypeStruct((probe_n,), jnp.int32)
+    ang = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def f(a, c, t):
+        return _direct_rotation_pallas(a, c, t, probe_n, 0, probe_n,
+                                       conj=False)
+
+    # compile, not just lower: Mosaic failures surface at compile time
+    jax.jit(f).lower(amps, codes, ang).compile()
+
+
+def pallas_lowering_ok() -> bool:
+    """True when the fused Pallas term kernels lower on this backend;
+    cached per process.  On failure, warn once, record the downgrade in
+    the env report, and route through the XLA gather path instead."""
+    hit = _PALLAS_OK.get("ok")
+    if hit is not None:
+        return hit
+    try:
+        _probe_pallas_lowering()
+        ok = True
+    except Exception as e:
+        from .. import resilience
+
+        resilience.record_degradation(
+            "pallas-direct-rotation",
+            "fused Pallas term kernel failed to lower; falling back to "
+            f"the XLA gather path ({type(e).__name__}: {e})")
+        ok = False
+    _PALLAS_OK["ok"] = ok
+    return ok
+
 
 def _pl_routable(amps, n: int) -> bool:
     return (_PL_MIN_N <= n <= 32 and amps.dtype == jnp.float32
-            and jax.default_backend() == "tpu")
+            and jax.default_backend() == "tpu" and pallas_lowering_ok())
 
 
 def _pl_flip_signed(meta, fvals, x_ref, f_ref, srow_ref, slane_ref):

@@ -60,6 +60,9 @@ class Qureg:
         # boundary
         self._last_use: dict = {}
         self._use_clock: int = 0
+        # fusion drains executed on this register (window-boundary
+        # accounting for the resilience layer's checkpoint cadence)
+        self._drain_count: int = 0
 
     # -- reference-parity metadata (QuEST.h:330-345) --
     @property
@@ -135,6 +138,18 @@ class Qureg:
                 range(self.num_qubits_in_state_vec)):
             perm = None
         self._perm = None if perm is None else tuple(perm)
+
+    def bind_checkpoint_state(self, amps: jax.Array, perm, dtype) -> None:
+        """Rebind this register to checkpointed state: raw (possibly
+        permuted) amplitudes, the live logical->physical permutation, and
+        the dtype the snapshot was taken at — the restore half of the
+        resilience layer's generation protocol (resilience.py).  Unlike
+        the ``amps`` setter this preserves the permutation; any pending
+        fused gates are discarded (they predate the snapshot)."""
+        if self._fusion is not None and self._fusion.gates:
+            self._fusion.gates.clear()
+        self.dtype = np.dtype(dtype)
+        self._set_amps_permuted(amps, perm)
 
     def _phys_bits(self, bits) -> tuple:
         """Physical positions of logical state-vector bits under the live

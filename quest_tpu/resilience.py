@@ -1,0 +1,621 @@
+"""Fault-tolerant execution: resumable circuit runs, fault injection, and
+a numerical-health watchdog.
+
+The reference QuEST has no persistence story beyond a debug CSV dump
+(reportState, QuEST_common.c:229-245) — a crashed multi-hour run loses
+everything.  On preemptible TPU pods (ROADMAP.md north star) preemption is
+the COMMON case, and distributed simulators at this scale treat long-run
+survivability and numerical drift as first-class engineering problems
+(mpiQulacs, arXiv:2203.16044 §V; qHiPSTER, arXiv:1601.07195 §IV).  This
+module is that layer for quest_tpu:
+
+* **Resumable execution** — :func:`run_resumable` drives a gate stream in
+  fusion windows of ``every`` gates, checkpointing at window boundaries
+  (never mid-window) with a generation protocol: a new generation is
+  written beside the last-good one and only *committed* (an atomic
+  ``LATEST`` pointer rename) after the asynchronous orbax write finishes,
+  so a crash mid-save always leaves a loadable checkpoint.  The metadata
+  extends ``saveQureg``'s with the circuit cursor (gate index), the live
+  logical->physical permutation (``Qureg._perm`` — saved RAW, because
+  rematerializing canonical order would change the downstream fold order
+  and break bit-exact resume), and the measurement-RNG state (host MT19937
+  + device key/shot counter), so a resumed run is bit-identical to an
+  uninterrupted one.
+
+* **Fault injection** — a deterministic :class:`FaultPlan`
+  (``QT_FAULT_PLAN`` env var or programmatic) injects preemption-style
+  kills between windows, kills mid-save, post-commit checkpoint
+  corruption, transient IO errors (exercising :func:`retry_io`'s bounded
+  exponential backoff), amplitude NaN/Inf corruption in one shard, and
+  norm drift.
+
+* **Numerical-health watchdog** — :func:`check_qureg_health` is one
+  jitted on-device scan (sum of |amps|^2 — a psum across shards under
+  GSPMD — plus an isfinite reduction) costing a single scalar readback;
+  :func:`run_resumable` runs it every window and before every checkpoint,
+  with policies ``raise`` (structured :class:`NumericalHealthError` naming
+  the offending window), ``renormalize`` (norm-drift only), and
+  ``rollback`` (restore the last-good checkpoint, then raise with the
+  rollback context so the caller can re-enter ``run_resumable``).
+
+* **Graceful degradation** — a process-wide registry
+  (:func:`record_degradation`) that subsystems report irreversible
+  downgrades into (e.g. ops/paulis.py falling back from the fused Pallas
+  direct-rotation kernel to the XLA gather path when lowering fails);
+  ``getEnvironmentString`` (env.py) appends the report.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import time
+import warnings
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .validation import QuESTError
+
+# ---------------------------------------------------------------------------
+# Degradation registry (graceful-downgrade observability)
+# ---------------------------------------------------------------------------
+
+# name -> reason; written once per process by subsystems that fell back to
+# a slower-but-working path (env.get_environment_string reports it)
+DEGRADATIONS: dict = {}
+
+
+def record_degradation(name: str, reason: str) -> None:
+    """Record (and warn about, once) an irreversible in-process downgrade
+    — e.g. a Pallas kernel that failed to lower and fell back to XLA."""
+    if name in DEGRADATIONS:
+        return
+    DEGRADATIONS[name] = reason
+    warnings.warn(f"quest_tpu degraded: {name}: {reason}", stacklevel=2)
+
+
+def degradation_report() -> dict:
+    """Snapshot of every recorded downgrade (name -> reason)."""
+    return dict(DEGRADATIONS)
+
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by an injected ``kill``/``killsave`` fault — stands in for
+    the SIGKILL a preemptible pod receives; deliberately NOT a QuESTError
+    so resilience tests can't confuse it with a validation failure."""
+
+
+class NumericalHealthError(QuESTError):
+    """The watchdog found a non-finite amplitude or norm drift beyond
+    tolerance.  Carries the offending window so logs name the gate range,
+    and the rollback cursor when the ``rollback`` policy restored state."""
+
+    def __init__(self, msg: str, *, window: Optional[Tuple[int, int]] = None,
+                 norm: Optional[float] = None, finite: bool = True,
+                 rolled_back_to: Optional[int] = None):
+        super().__init__(msg)
+        self.window = window
+        self.norm = norm
+        self.finite = finite
+        self.rolled_back_to = rolled_back_to
+
+
+# ---------------------------------------------------------------------------
+# Bounded exponential-backoff retry for checkpoint IO
+# ---------------------------------------------------------------------------
+
+# transient-IO retry policy: attempts and base delay are env-tunable so
+# tests (and impatient operators) can shrink the backoff
+_RETRY_ATTEMPTS_ENV = "QT_RETRY_ATTEMPTS"
+_RETRY_BASE_ENV = "QT_RETRY_BASE_SECONDS"
+
+# the FaultPlan currently driving a run_resumable (or a test) — retry_io
+# consults it for injected transient errors
+_ACTIVE_FAULTS: List[Optional["FaultPlan"]] = [None]
+
+
+def retry_io(fn, *args, attempts: Optional[int] = None,
+             base_delay: Optional[float] = None, what: str = "checkpoint IO",
+             **kwargs):
+    """Call ``fn`` retrying transient IO failures (OSError/TimeoutError)
+    with bounded exponential backoff — the wrapper around every orbax /
+    metadata save+load.  A persistent failure re-raises the last error
+    wrapped in a QuESTError naming the operation and attempt count."""
+    if attempts is None:
+        attempts = int(os.environ.get(_RETRY_ATTEMPTS_ENV, "4"))
+    if base_delay is None:
+        base_delay = float(os.environ.get(_RETRY_BASE_ENV, "0.05"))
+    last = None
+    for k in range(max(1, attempts)):
+        plan = _ACTIVE_FAULTS[0]
+        if plan is not None and plan.take_io_fault():
+            last = OSError(f"injected transient IO error ({what})")
+        else:
+            try:
+                return fn(*args, **kwargs)
+            except (OSError, TimeoutError) as e:  # includes IOError
+                last = e
+        if k + 1 < attempts:
+            time.sleep(base_delay * (1 << k))
+    raise QuESTError(
+        f"{what}: failed after {attempts} attempts "
+        f"(last error: {last!r})") from last
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults, keyed on the ABSOLUTE
+    window index of a resumable run (window w covers gates
+    [w*every, (w+1)*every)).  Build programmatically
+    (``FaultPlan("kill@2,io@3")``) or from the ``QT_FAULT_PLAN`` env var
+    (:meth:`from_env`).  Kinds:
+
+    - ``kill@W``      raise SimulatedPreemption before executing window W
+    - ``killsave@W``  crash mid-save: after window W's checkpoint data is
+                      scheduled but BEFORE the LATEST commit
+    - ``corrupt@W``   after committing window W's generation, truncate its
+                      amplitude payload and garbage its metadata
+    - ``io@N``        the next N checkpoint IO operations raise a
+                      transient OSError (absorbed by retry_io's backoff)
+    - ``nan@W``       poke NaN into one shard of the amplitudes after
+                      window W executes (before its watchdog check)
+    - ``inf@W``       same with +Inf
+    - ``scale@W``     multiply the amplitudes by 1.01 after window W
+                      (norm drift for the ``renormalize`` policy)
+
+    Every fired event is appended to :attr:`log` so tests can assert the
+    plan actually executed."""
+
+    _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale")
+
+    def __init__(self, spec: str = ""):
+        self.events: List[Tuple[str, int]] = []
+        self.io_budget = 0
+        self.log: List[str] = []
+        spec = (spec or "").strip()
+        if spec:
+            for part in spec.split(","):
+                kind, _, arg = part.strip().partition("@")
+                kind = kind.strip()
+                if kind not in self._KINDS:
+                    raise QuESTError(
+                        f"FaultPlan: unknown fault kind {kind!r} "
+                        f"(expected one of {self._KINDS})")
+                val = int(arg) if arg else 0
+                if kind == "io":
+                    self.io_budget += val
+                else:
+                    self.events.append((kind, val))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get("QT_FAULT_PLAN", "")
+        return cls(spec) if spec.strip() else None
+
+    # -- hooks consumed by run_resumable / retry_io --
+
+    def _fire(self, kind: str, window: int) -> bool:
+        key = (kind, window)
+        if key in self.events:
+            self.events.remove(key)
+            self.log.append(f"{kind}@{window}")
+            return True
+        return False
+
+    def maybe_kill(self, window: int) -> None:
+        if self._fire("kill", window):
+            raise SimulatedPreemption(
+                f"injected preemption before window {window}")
+
+    def maybe_kill_mid_save(self, window: int) -> None:
+        if self._fire("killsave", window):
+            raise SimulatedPreemption(
+                f"injected preemption mid-save of window {window}'s "
+                "checkpoint (before commit)")
+
+    def should_corrupt(self, window: int) -> bool:
+        return self._fire("corrupt", window)
+
+    def take_io_fault(self) -> bool:
+        if self.io_budget > 0:
+            self.io_budget -= 1
+            self.log.append("io")
+            return True
+        return False
+
+    def maybe_corrupt_amps(self, qureg, window: int) -> None:
+        """nan/inf/scale amplitude corruption, preserving any live
+        permutation (the corruption is physical, like a real bit flip)."""
+        for kind, val in (("nan", np.nan), ("inf", np.inf), ("scale", 1.01)):
+            if not self._fire(kind, window):
+                continue
+            amps = qureg._amps_raw()
+            perm = qureg._perm
+            if kind == "scale":
+                amps = amps * np.asarray(val, amps.dtype)
+            else:
+                # one poisoned amplitude in the LAST shard (highest index)
+                amps = amps.at[0, amps.shape[1] - 1].set(val)
+            qureg._set_amps_permuted(amps, perm)
+
+
+# ---------------------------------------------------------------------------
+# Numerical-health watchdog
+# ---------------------------------------------------------------------------
+
+
+_HEALTH_FNS: dict = {}
+
+
+def _health_fn():
+    """Jitted health scan: (sum |amps|^2, all-finite flag) in ONE device
+    program — on a sharded register the reductions are GSPMD psums — and
+    one scalar readback for both (the (2,) result array)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _HEALTH_FNS.get("fn")
+    if fn is None:
+        @jax.jit
+        def fn(amps):
+            sq = amps[0] * amps[0] + amps[1] * amps[1]
+            norm = jnp.sum(sq)
+            finite = jnp.all(jnp.isfinite(amps))
+            return jnp.stack([norm, finite.astype(amps.dtype)])
+
+        _HEALTH_FNS["fn"] = fn
+    return fn
+
+
+def check_qureg_health(qureg) -> Tuple[float, bool]:
+    """(sum |amps|^2, all-finite) of the register, via one jitted
+    on-device reduction and one host readback.  Pending fused gates drain
+    first, but a live permutation is NOT rematerialized — both reductions
+    are permutation-invariant."""
+    out = np.asarray(_health_fn()(qureg._amps_raw()))
+    return float(out[0]), bool(out[1])
+
+
+# watchdog policies; "raise" is the default (fail fast, keep the ckpt)
+WATCHDOG_POLICIES = ("raise", "renormalize", "rollback")
+
+
+def _health_tolerance(dtype) -> float:
+    # norm drift beyond sqrt-eps of the working dtype means something is
+    # genuinely wrong (a healthy fused pass preserves the norm to ~eps)
+    return 1e-6 if np.dtype(dtype) == np.float64 else 1e-3
+
+
+# ---------------------------------------------------------------------------
+# Generation-based checkpoint protocol
+# ---------------------------------------------------------------------------
+
+_LATEST = "LATEST"
+_COMMIT = "COMMITTED"
+_GENS_KEPT = 2  # last-good + one predecessor (corruption fallback)
+
+
+def _gen_name(cursor: int) -> str:
+    return f"gen-{cursor:010d}"
+
+
+def _gen_cursor(name: str) -> Optional[int]:
+    if not name.startswith("gen-"):
+        return None
+    try:
+        return int(name[4:])
+    except ValueError:
+        return None
+
+
+def circuit_fingerprint(gates: Sequence, num_qubits: int, every: int) -> str:
+    """Content hash binding a checkpoint to (circuit, register width,
+    window cadence): resuming under ANY difference that would change the
+    window plans is refused up front rather than silently diverging."""
+    h = hashlib.sha256()
+    h.update(f"n={num_qubits};every={every};gates={len(gates)};".encode())
+    for g in gates:
+        h.update(repr(tuple(g.targets)).encode())
+        m = g.mat
+        if isinstance(m, np.ndarray):
+            h.update(m.tobytes())
+    return h.hexdigest()
+
+
+def save_generation(qureg, ckpt_dir: str, cursor: int, *,
+                    fingerprint: str = "", faults: Optional[FaultPlan] = None,
+                    window: int = -1) -> str:
+    """Write generation ``cursor`` of ``qureg`` under ``ckpt_dir`` and
+    commit it as last-good.  The amplitude payload is written
+    asynchronously (orbax schedules the device->host copy synchronously,
+    then persists in background); the commit — a COMMITTED marker plus an
+    atomic LATEST pointer rename — happens only after the write finishes,
+    so a crash at ANY point before commit leaves the previous LATEST
+    generation intact and loadable.  Saves the RAW (possibly permuted)
+    amplitudes plus ``Qureg._perm`` and the measurement-RNG state, the
+    three extra pieces bit-exact resume needs beyond ``saveQureg``."""
+    from . import checkpoint as CKPT
+    from . import rng as _rng
+    from .ops import measurement as M
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    gen = os.path.join(ckpt_dir, _gen_name(cursor))
+    if os.path.exists(gen):  # stale uncommitted leftover from a crash
+        shutil.rmtree(gen)
+    os.makedirs(gen)
+    amps = qureg._amps_raw()  # drain pending gates; keep the live perm
+    ckptr = CKPT._checkpointer()
+    retry_io(ckptr.save, os.path.join(gen, CKPT._AMPS_NAME),
+             {"amps": amps}, force=True, what="saveQureg(amps)")
+    meta = CKPT._qureg_meta(qureg)
+    meta.update({
+        "cursor": int(cursor),
+        "perm": list(qureg._perm) if qureg._perm is not None else None,
+        "fingerprint": fingerprint,
+        "rng": _rng.GLOBAL_RNG.get_state(),
+        "measure_keys": M.KEYS.get_state(),
+    })
+    retry_io(CKPT._write_meta, gen, meta, what="saveQureg(meta)")
+    # ---- commit point ----
+    retry_io(ckptr.wait_until_finished, what="saveQureg(wait)")
+    if faults is not None:
+        faults.maybe_kill_mid_save(window)
+    with open(os.path.join(gen, _COMMIT), "w") as f:
+        f.write(_gen_name(cursor) + "\n")
+    tmp = os.path.join(ckpt_dir, _LATEST + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(_gen_name(cursor) + "\n")
+    os.replace(tmp, os.path.join(ckpt_dir, _LATEST))
+    if faults is not None and faults.should_corrupt(window):
+        _corrupt_generation(gen)
+    _prune_generations(ckpt_dir, keep=_GENS_KEPT)
+    return gen
+
+
+def _corrupt_generation(gen: str) -> None:
+    """Injected corruption: truncate every data file and garbage the
+    metadata — models a torn write / bad disk."""
+    for root, _dirs, files in os.walk(gen):
+        for fname in files:
+            if fname == _COMMIT:
+                continue
+            p = os.path.join(root, fname)
+            with open(p, "wb") as f:
+                f.write(b"\x00CORRUPT\x00")
+
+
+def _committed_generations(ckpt_dir: str) -> List[int]:
+    """Committed generation cursors, newest first."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for name in names:
+        c = _gen_cursor(name)
+        if c is None:
+            continue
+        if os.path.exists(os.path.join(ckpt_dir, name, _COMMIT)):
+            out.append(c)
+    return sorted(out, reverse=True)
+
+
+def _prune_generations(ckpt_dir: str, keep: int) -> None:
+    """Drop all but the ``keep`` newest committed generations.  An
+    UNCOMMITTED generation newer than every committed one is an in-flight
+    write (possibly another process's) and is left alone."""
+    committed = _committed_generations(ckpt_dir)
+    keep_set = {_gen_name(c) for c in committed[:keep]}
+    newest = committed[0] if committed else -1
+    for name in os.listdir(ckpt_dir):
+        c = _gen_cursor(name)
+        if c is None or name in keep_set:
+            continue
+        is_committed = os.path.exists(os.path.join(ckpt_dir, name, _COMMIT))
+        if not is_committed and c > newest:
+            continue
+        shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+
+
+def _load_generation(ckpt_dir: str, cursor: int, env):
+    from . import checkpoint as CKPT
+
+    gen = os.path.join(ckpt_dir, _gen_name(cursor))
+    meta = CKPT._read_meta(gen)
+    q = CKPT._qureg_from_meta(meta, env)
+    amps = CKPT._restore_amps(gen, q)
+    perm = meta.get("perm")
+    q._set_amps_permuted(amps, tuple(perm) if perm else None)
+    return q, meta
+
+
+def load_latest(ckpt_dir: str, env):
+    """Load the newest loadable committed generation under ``ckpt_dir``.
+    Returns (qureg, meta) or None when no checkpoint exists.  A corrupt
+    newest generation (torn write, bad disk) falls back to its
+    predecessor with a warning; genuine environment mismatches
+    (precision/qubit count vs this env) are surfaced as QuESTError, not
+    swallowed."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if not os.path.isdir(ckpt_dir):
+        return None
+    candidates = _committed_generations(ckpt_dir)
+    # prefer the LATEST pointer's target ordering but never trust it
+    # blindly — it may name a pruned or corrupted generation
+    try:
+        with open(os.path.join(ckpt_dir, _LATEST)) as f:
+            pointed = _gen_cursor(f.read().strip())
+        if pointed in candidates:
+            candidates.remove(pointed)
+            candidates.insert(0, pointed)
+    except OSError:
+        pass
+    if not candidates:
+        return None
+    last_err = None
+    for cursor in candidates:
+        try:
+            return _load_generation(ckpt_dir, cursor, env)
+        except QuESTError:
+            raise  # structured mismatch (precision/qubits): not corruption
+        except Exception as e:  # corrupt payload/metadata: try older gen
+            last_err = e
+            warnings.warn(
+                f"run_resumable: checkpoint generation {cursor} at "
+                f"{ckpt_dir} is unreadable ({e!r}); falling back to an "
+                "older generation", stacklevel=2)
+    raise QuESTError(
+        f"run_resumable: no loadable checkpoint generation under "
+        f"{ckpt_dir} (last error: {last_err!r})")
+
+
+# ---------------------------------------------------------------------------
+# Resumable driver
+# ---------------------------------------------------------------------------
+
+
+def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
+                  watchdog: str = "raise",
+                  faults: Optional[FaultPlan] = None):
+    """Execute ``gates`` (a sequence of :class:`quest_tpu.circuit.Gate`,
+    or ``(targets, mat)`` pairs, on state-vector bit positions) on
+    ``qureg`` in fusion windows of ``every`` gates, checkpointing at every
+    window boundary — never mid-window — into ``ckpt_dir``.
+
+    If ``ckpt_dir`` already holds a committed checkpoint for this
+    (circuit, register, cadence) — matched by content fingerprint — the
+    run RESUMES from its cursor: the register is rebound to the saved
+    amplitudes (raw, with the live logical->physical permutation
+    restored), the measurement RNG state is restored, and the remaining
+    windows execute exactly as the uninterrupted run would, producing
+    bit-identical amplitudes.
+
+    ``watchdog``: one of ``raise`` / ``renormalize`` / ``rollback``
+    (see module docstring).  ``faults``: a :class:`FaultPlan`; defaults
+    to ``QT_FAULT_PLAN`` when set.  Returns ``qureg``."""
+    from . import circuit as C
+    from . import fusion as _fusion
+
+    if watchdog not in WATCHDOG_POLICIES:
+        raise QuESTError(
+            f"run_resumable: unknown watchdog policy {watchdog!r} "
+            f"(expected one of {WATCHDOG_POLICIES})")
+    if every < 1:
+        raise QuESTError("run_resumable: every must be >= 1")
+    glist = [g if isinstance(g, C.Gate) else C.Gate(tuple(g[0]), g[1])
+             for g in gates]
+    if faults is None:
+        faults = FaultPlan.from_env()
+    fp = circuit_fingerprint(glist, qureg.num_qubits_in_state_vec, every)
+
+    start = 0
+    loaded = load_latest(ckpt_dir, qureg.env)
+    if loaded is not None:
+        restored, meta = loaded
+        if meta.get("fingerprint") not in ("", fp):
+            raise QuESTError(
+                "run_resumable: checkpoint at "
+                f"{ckpt_dir} was written by a different circuit/cadence "
+                f"(saved fingerprint {meta.get('fingerprint')!r} != this "
+                f"run's {fp!r}); refusing to resume")
+        _restore_into(qureg, restored, meta)
+        start = int(meta.get("cursor", 0))
+
+    _ACTIVE_FAULTS[0] = faults
+    try:
+        boundaries = C.plan_checkpoint_boundaries(len(glist), every,
+                                                  start=start)
+        cursor = start
+        for end in boundaries:
+            window = cursor // every
+            if faults is not None:
+                faults.maybe_kill(window)
+            _fusion.start_gate_fusion(qureg)
+            try:
+                qureg._fusion.gates.extend(glist[cursor:end])
+            finally:
+                _fusion.stop_gate_fusion(qureg)  # drain: the window pass
+            if faults is not None:
+                faults.maybe_corrupt_amps(qureg, window)
+            _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end))
+            cursor = end
+            save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
+                            faults=faults, window=window)
+        return qureg
+    finally:
+        _ACTIVE_FAULTS[0] = None
+
+
+def _restore_into(qureg, restored, meta) -> None:
+    """Rebind ``qureg`` to a loaded generation's state (amps + perm +
+    dtype) and restore the measurement RNG streams."""
+    from . import rng as _rng
+    from .ops import measurement as M
+
+    if restored.num_qubits_in_state_vec != qureg.num_qubits_in_state_vec \
+            or restored.is_density_matrix != qureg.is_density_matrix:
+        raise QuESTError(
+            "run_resumable: checkpoint register shape "
+            f"({restored.num_qubits_represented} qubits, density="
+            f"{restored.is_density_matrix}) does not match the target "
+            f"register ({qureg.num_qubits_represented} qubits, density="
+            f"{qureg.is_density_matrix})")
+    qureg.bind_checkpoint_state(restored._amps, restored._perm,
+                                restored.dtype)
+    if meta.get("rng") is not None:
+        _rng.GLOBAL_RNG.set_state(meta["rng"])
+    if meta.get("measure_keys") is not None:
+        M.KEYS.set_state(meta["measure_keys"])
+
+
+def _watchdog_step(qureg, ckpt_dir: str, policy: str,
+                   window: Tuple[int, int]) -> None:
+    norm, finite = check_qureg_health(qureg)
+    tol = _health_tolerance(qureg.dtype)
+    drift = abs(norm - 1.0)
+    # density matrices: sum |rho_ij|^2 is the purity, <= 1 and legitimately
+    # < 1 under noise — only finiteness is checked for them
+    norm_bad = (not qureg.is_density_matrix) and drift > tol
+    if finite and not norm_bad:
+        return
+    desc = ("non-finite amplitudes" if not finite
+            else f"norm drift |{norm:.6g} - 1| > {tol:g}")
+    msg = (f"numerical-health check failed in window "
+           f"[{window[0]}, {window[1]}): {desc}")
+    if finite and policy == "renormalize":
+        # norm drift only: rescale in place (keeps the live permutation)
+        import jax.numpy as jnp
+
+        amps = qureg._amps_raw()
+        perm = qureg._perm
+        scale = jnp.asarray(1.0 / np.sqrt(norm), amps.dtype)
+        qureg._set_amps_permuted(amps * scale, perm)
+        warnings.warn(f"run_resumable: {msg}; renormalized", stacklevel=2)
+        return
+    if policy == "rollback":
+        loaded = load_latest(ckpt_dir, qureg.env)
+        if loaded is not None:
+            restored, meta = loaded
+            _restore_into(qureg, restored, meta)
+            raise NumericalHealthError(
+                f"{msg}; rolled back to last-good checkpoint at gate "
+                f"cursor {meta.get('cursor', 0)} — re-run run_resumable "
+                "to resume from it",
+                window=window, norm=norm, finite=finite,
+                rolled_back_to=int(meta.get("cursor", 0)))
+        raise NumericalHealthError(
+            f"{msg}; no last-good checkpoint exists to roll back to",
+            window=window, norm=norm, finite=finite)
+    raise NumericalHealthError(msg, window=window, norm=norm, finite=finite)

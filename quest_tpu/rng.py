@@ -33,5 +33,36 @@ class _MeasurementRNG:
     def uniform(self) -> float:
         return float(self._rng.random_sample())
 
+    # -- state round-trip (resumable execution, resilience.py) --
+
+    def get_state(self) -> dict:
+        """JSON-serializable MT19937 state snapshot: restoring it with
+        :meth:`set_state` continues the measurement-outcome stream exactly
+        where it left off, so a resumed run draws the same outcomes an
+        uninterrupted run would."""
+        name, key, pos, has_gauss, cached = self._rng.get_state()
+        return {
+            "seeds": [int(k) for k in self._keys],
+            "algo": name,
+            "key": [int(x) for x in key],
+            "pos": int(pos),
+            "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached),
+        }
+
+    def set_state(self, state: dict) -> None:
+        """Restore a snapshot from :meth:`get_state` (bit-exact stream
+        continuation)."""
+        self._keys = [int(k) & 0xFFFFFFFF for k in state["seeds"]]
+        self._rng = np.random.RandomState(
+            np.random.MT19937(np.array(self._keys, dtype=np.uint32)))
+        self._rng.set_state((
+            state.get("algo", "MT19937"),
+            np.array(state["key"], dtype=np.uint32),
+            int(state["pos"]),
+            int(state["has_gauss"]),
+            float(state["cached_gaussian"]),
+        ))
+
 
 GLOBAL_RNG = _MeasurementRNG()

@@ -120,6 +120,10 @@ ERROR_MESSAGES = {
     "E_NEGATIVE_EXPONENT_MULTI_VAR": "The phase function contained an illegal negative exponent. One must instead call applyPhaseFuncOverrides() once for each register, so that the zero index of each register is overriden, independent of the indices of all other registers.",
     "E_FRACTIONAL_EXPONENT_MULTI_VAR": "The phase function contained a fractional exponent, which is illegal in TWOS_COMPLEMENT encoding, since it cannot be (efficiently) checked that all negative indices were overriden. One must instead call applyPhaseFuncOverrides() once for each register, so that each register's negative indices can be overriden, independent of the indices of all other registers.",
     "E_INVALID_NUM_REGS_DISTANCE_PHASE_FUNC": "Phase functions DISTANCE, INVERSE_DISTANCE, SCALED_DISTANCE and SCALED_INVERSE_DISTANCE require a strictly even number of sub-registers.",
+    # extension (no reference analogue): the reference's C API cannot
+    # receive NaN/Inf without UB downstream; here they must be rejected
+    # up front or they poison every later amplitude (ISSUE 2).
+    "E_NOT_FINITE": "Invalid input. Matrix, diagonal-operator and amplitude values must be finite (no NaN or Inf).",
 }
 
 
@@ -355,12 +359,34 @@ def _as_matrix(u) -> np.ndarray:
     return np.asarray(u, dtype=np.complex128)
 
 
+def validate_finite(values, func: str):
+    """Reject NaN/Inf in user-supplied numeric payloads (matrices,
+    diagonal operators, setAmps/initStateFromAmps amplitudes).  EXTENSION:
+    the reference never checks finiteness — a single NaN silently poisons
+    the whole register on the first sweep; the numerical-health watchdog
+    (resilience.py) would catch it only K gates later, so the cheap host
+    check here names the offending call instead.  Traced values (inside
+    jit) are skipped — they are unknowable at validation time."""
+    try:
+        arr = np.asarray(values)
+    except Exception:
+        return  # traced / non-materializable: nothing to check host-side
+    if arr.dtype == object or not np.issubdtype(arr.dtype, np.number):
+        return
+    if not np.all(np.isfinite(arr)):
+        _raise("E_NOT_FINITE", func)
+
+
 def validate_matrix_size(u, num_targets: int, func: str):
-    """part of validateMultiQubitMatrix (:492-496)."""
+    """part of validateMultiQubitMatrix (:492-496); also rejects
+    non-finite entries (validate_finite) — this validator guards both the
+    unitary and the no-unitarity-check apply* families, so the finiteness
+    gate holds even where unitarity is deliberately skipped."""
     m = _as_matrix(u)
     dim = 1 << num_targets
     if m.shape != (dim, dim):
         _raise("E_INVALID_UNITARY_SIZE", func)
+    validate_finite(m, func)
 
 
 def validate_unitary(u, num_targets: int, func: str):

@@ -1,0 +1,109 @@
+"""Overhead of the resilience layer on the bench config-6 workload.
+
+Mirrors bench_suite.config6's gate stream (alternating shard-local and
+sharded-target random 2q unitaries on the 8-shard dryrun mesh) and runs
+it (a) as plain fusion windows and (b) through resilience.run_resumable
+with the every=64 checkpoint+watchdog cadence, reporting wall clock and
+the per-checkpoint cost (ISSUE 2 acceptance: measure the every=64
+cadence overhead on config 6).
+
+Usage: python scripts/bench_resilience.py [--n 10] [--depth 64] [--every 64]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import circuit as C  # noqa: E402
+from quest_tpu import fusion  # noqa: E402
+
+
+def _arg(flag, default):
+    return int(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def main():
+    n = _arg("--n", 10)
+    depth = _arg("--depth", 64)
+    every = _arg("--every", 64)
+    env = qt.createQuESTEnv()
+    rng = np.random.default_rng(11)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    soa = np.stack([u.real, u.imag])
+    gates = []
+    for _ in range(depth):
+        gates.append(C.Gate((0, 1), soa))          # shard-local
+        gates.append(C.Gate((n - 2, n - 1), soa))  # sharded targets
+
+    def run_plain():
+        qt.seedQuEST(env, [3])
+        q = qt.createQureg(n, env)
+        for cur in range(0, len(gates), every):
+            fusion.start_gate_fusion(q)
+            q._fusion.gates.extend(gates[cur:cur + every])
+            fusion.stop_gate_fusion(q)
+        return q.amps.block_until_ready()
+
+    def run_resumable():
+        qt.seedQuEST(env, [3])
+        q = qt.createQureg(n, env)
+        d = tempfile.mkdtemp(prefix="qt_bench_res_")
+        try:
+            qt.run_resumable(q, gates, d, every=every)
+            return q.amps.block_until_ready()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def best_of(fn, reps=5):
+        fn()  # warm compile caches
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times), sorted(times)[len(times) // 2]
+
+    plain_best, plain_med = best_of(run_plain)
+    res_best, res_med = best_of(run_resumable)
+    n_ckpts = len(C.plan_checkpoint_boundaries(len(gates), every))
+    out = {
+        "config": 6,
+        "metric": f"{n}q depth-{depth} resilience overhead (every={every})",
+        "gates": len(gates),
+        "checkpoints": n_ckpts,
+        "plain_seconds_best": round(plain_best, 4),
+        "resumable_seconds_best": round(res_best, 4),
+        "overhead_seconds_best": round(res_best - plain_best, 4),
+        "overhead_pct_best": round(100 * (res_best / plain_best - 1), 1),
+        "per_checkpoint_seconds": round((res_best - plain_best)
+                                        / max(n_ckpts, 1), 4),
+        "plain_seconds_median": round(plain_med, 4),
+        "resumable_seconds_median": round(res_med, 4),
+        "devices": env.num_devices,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

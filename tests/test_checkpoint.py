@@ -35,6 +35,61 @@ class TestOrbaxCheckpoint:
         with pytest.raises(qt.QuESTError):
             qt.loadQureg(str(tmp_path / "nope"), env)
 
+    def test_precision_mismatch_raises_structured(self, env, tmp_path):
+        """ISSUE 2 satellite: a checkpoint written at prec 2 loaded at
+        prec 1 must raise a QuESTError naming both sides, not fail deep
+        inside orbax resharding."""
+        q = qt.createQureg(4, env)
+        qt.initDebugState(q)
+        qt.saveQureg(q, str(tmp_path / "ckpt"))
+        qt.set_precision(1)
+        try:
+            with pytest.raises(qt.QuESTError) as ei:
+                qt.loadQureg(str(tmp_path / "ckpt"), env)
+        finally:
+            qt.set_precision(2)
+        msg = str(ei.value)
+        assert "float64" in msg and "float32" in msg
+        assert "precision mismatch" in msg
+        # back at the written precision the same checkpoint loads fine
+        q2 = qt.loadQureg(str(tmp_path / "ckpt"), env)
+        np.testing.assert_allclose(np.asarray(q2.amps), np.asarray(q.amps),
+                                   atol=0)
+
+    def test_mesh_grown_past_shardable_size_raises(self, env, tmp_path):
+        """A register too small to put one amplitude on each device of a
+        GROWN mesh is refused with both sides named."""
+        import quest_tpu.checkpoint as CKPT
+
+        if env.num_devices < 2:
+            pytest.skip("needs a multi-device mesh")
+        q = qt.createQureg(4, env)
+        qt.saveQureg(q, str(tmp_path / "ckpt"))
+        meta = CKPT._read_meta(str(tmp_path / "ckpt"))
+        meta["num_qubits_represented"] = 1  # as if saved on a tiny mesh
+        CKPT._write_meta(str(tmp_path / "ckpt"), meta)
+        with pytest.raises(qt.QuESTError) as ei:
+            qt.loadQureg(str(tmp_path / "ckpt"), env)
+        msg = str(ei.value)
+        assert "mesh has grown" in msg
+        assert f"{env.num_devices} devices" in msg
+
+    def test_transient_io_error_retried(self, env, tmp_path, monkeypatch):
+        """saveQureg rides the bounded-backoff retry wrapper: two
+        injected transient failures are absorbed."""
+        from quest_tpu import resilience as R
+
+        monkeypatch.setenv("QT_RETRY_BASE_SECONDS", "0.001")
+        plan = qt.FaultPlan("io@2")
+        monkeypatch.setattr(R, "_ACTIVE_FAULTS", [plan])
+        q = qt.createQureg(4, env)
+        qt.initDebugState(q)
+        qt.saveQureg(q, str(tmp_path / "ckpt"))
+        assert plan.io_budget == 0
+        q2 = qt.loadQureg(str(tmp_path / "ckpt"), env)
+        np.testing.assert_allclose(np.asarray(q2.amps), np.asarray(q.amps),
+                                   atol=0)
+
 
 class TestCSVRoundtrip:
     def test_write_read(self, env, tmp_path):
@@ -102,6 +157,52 @@ class TestCSVRoundtrip:
         # ...but the streamed reader round-trips
         q2 = qt.createQureg(5, env)
         assert qt.readStateFromFile(q2, path)
+        np.testing.assert_allclose(oracle.state_from_qureg(q2), before,
+                                   atol=1e-12)
+
+    def test_garbage_binary_file_leaves_state_untouched(self, env,
+                                                        tmp_path):
+        """ISSUE 2 satellite: a corrupt (binary-garbage) file must report
+        failure and restore nothing — the streamed reader only rebinds on
+        full success."""
+        path = tmp_path / "garbage.csv"
+        path.write_bytes(b"\x00\xff\xfe corrupted \x80\x81\n" * 16)
+        q = qt.createQureg(3, env)
+        qt.initDebugState(q)
+        before = np.asarray(q.amps).copy()
+        assert not qt.readStateFromFile(q, str(path))
+        np.testing.assert_allclose(np.asarray(q.amps), before)
+
+    def test_nonfinite_values_rejected(self, env, tmp_path):
+        """NaN/Inf in a state CSV is bit rot, not data: reject and leave
+        the register untouched."""
+        for bad in ("nan, 0.0", "0.0, inf", "-inf, 0.0"):
+            path = tmp_path / "bad.csv"
+            path.write_text("0.5, 0.0\n" + bad + "\n" + "0.5, 0.0\n" * 6)
+            q = qt.createQureg(3, env)
+            qt.initDebugState(q)
+            before = np.asarray(q.amps).copy()
+            assert not qt.readStateFromFile(q, str(path))
+            np.testing.assert_allclose(np.asarray(q.amps), before)
+
+    def test_corrupt_file_roundtrip_recovers(self, env, tmp_path):
+        """Corrupt-file round-trip: write -> corrupt -> failed read leaves
+        the target usable -> re-write -> read succeeds."""
+        q = qt.createQureg(4, env)
+        qt.initDebugState(q)
+        qt.rotateY(q, 2, 0.4)
+        before = oracle.state_from_qureg(q)
+        path = tmp_path / "state.csv"
+        qt.writeStateToFile(q, str(path))
+        good = path.read_text()
+        path.write_text(good[: len(good) // 2] + "\x00garbage")
+        q2 = qt.createQureg(4, env)
+        qt.initZeroState(q2)
+        zero = np.asarray(q2.amps).copy()
+        assert not qt.readStateFromFile(q2, str(path))
+        np.testing.assert_allclose(np.asarray(q2.amps), zero)
+        path.write_text(good)
+        assert qt.readStateFromFile(q2, str(path))
         np.testing.assert_allclose(oracle.state_from_qureg(q2), before,
                                    atol=1e-12)
 

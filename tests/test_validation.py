@@ -310,6 +310,77 @@ class TestPhaseFuncs:
                 [1, 1])
 
 
+class TestFiniteness:
+    """ISSUE 2 satellite: NaN/Inf in user-supplied payloads is rejected
+    up front (validation.validate_finite) — the reference never checks
+    and a single NaN silently poisons the whole register."""
+
+    MSG = "must be finite"
+
+    def test_unitary_matrix_nan(self, q):
+        m = np.eye(2, dtype=complex)
+        m[0, 0] = np.nan
+        with expect(self.MSG):
+            qt.unitary(q, 0, m)
+
+    def test_apply_matrix_n_inf(self, q):
+        m = np.eye(4, dtype=complex)
+        m[1, 2] = np.inf
+        with expect(self.MSG):
+            qt.applyMatrixN(q, [0, 1], m)
+
+    def test_apply_matrix2_nan(self, q):
+        with expect(self.MSG):
+            qt.applyMatrix2(q, 0, np.array([[np.nan, 0], [0, 1]]))
+
+    def test_set_amps_nan(self, q):
+        with expect(self.MSG):
+            qt.setAmps(q, 0, [np.nan, 0.0], [0.0, 0.0], 2)
+
+    def test_set_amps_imag_inf(self, q):
+        with expect(self.MSG):
+            qt.setAmps(q, 0, [0.0, 0.0], [0.0, -np.inf], 2)
+
+    def test_init_state_from_amps_nan(self, q):
+        re = np.zeros(1 << N)
+        re[3] = np.nan
+        with expect(self.MSG):
+            qt.initStateFromAmps(q, re, np.zeros(1 << N))
+
+    def test_set_density_amps_nan(self, rho):
+        d = 1 << (2 * N)
+        re = np.zeros(d)
+        re[0] = np.inf
+        with expect(self.MSG):
+            qt.setDensityAmps(rho, re, np.zeros(d))
+
+    def test_init_diagonal_op_nan(self, env):
+        op = qt.createDiagonalOp(3, env)
+        with expect(self.MSG):
+            qt.initDiagonalOp(op, [np.nan] * 8, [0.0] * 8)
+
+    def test_set_diagonal_op_elems_inf(self, env):
+        op = qt.createDiagonalOp(3, env)
+        with expect(self.MSG):
+            qt.setDiagonalOpElems(op, 0, [np.inf], [0.0], 1)
+
+    def test_finite_inputs_pass(self, q, env):
+        qt.unitary(q, 0, np.eye(2))
+        qt.setAmps(q, 0, [0.5, 0.5], [0.0, 0.0], 2)
+        op = qt.createDiagonalOp(3, env)
+        qt.initDiagonalOp(op, [1.0] * 8, [0.0] * 8)
+
+    def test_traced_values_skipped(self):
+        """validate_finite must not materialize tracers (jitted callers)."""
+        import jax
+
+        def f(x):
+            V.validate_finite(x, "jitfn")
+            return x
+
+        jax.jit(f)(np.ones(4))  # must not raise
+
+
 def test_strict_parity_mode_escalates_warn_codes(env, monkeypatch):
     """QT_STRICT_VALIDATION=1 turns the two deliberately-warn-only codes
     into QuESTError, matching reference REQUIRE_THROWS_WITH suites."""
