@@ -293,8 +293,106 @@ def config6():
            "prob_delta": abs(lazy_p - eager_p)})
 
 
+def config7():
+    """Pipelined chunked shard exchange A/B (ISSUE 3): the distributed
+    hot-path exchanges (sharded-target 1q gate, half-shard swap, batched
+    window remap) run monolithic (C=1) vs chunk-pipelined over a chunk
+    sweep C in {1, 2, 4, 8} on the 8-shard dryrun, measuring wall clock,
+    HLO collective-permute dispatch counts, and the per-exchange ICI
+    volume (circuit.remap_exchange_bytes for the remap).  On CPU there is
+    no async collective to overlap, so this config measures the OVERHEAD
+    side of the pipeline (the fallback-threshold calibration —
+    dist.PIPELINE_MIN_BYTES); the overlap win needs ICI (docs/design.md
+    §17)."""
+    import jax.numpy as jnp
+
+    import quest_tpu as qt
+    from quest_tpu import circuit as CIRC
+    from quest_tpu.parallel import dist
+
+    env = qt.createQuESTEnv()
+    if env.num_devices < 8:
+        _emit(7, "8-shard pipelined exchange (SKIPPED: needs 8 amp shards)",
+              0.0, "seconds", 0.0)
+        return
+    n = 20 if CPU else 26
+    reps = 8          # exchanges per timed run (amortizes dispatch noise)
+    rng = np.random.default_rng(13)
+    h = (1 / np.sqrt(2)) * np.array([[1.0, 1], [1, -1]])
+    m = jnp.asarray(np.stack([h, np.zeros((2, 2))]))
+    sigma = dist.canonical_sigma(
+        tuple([n - 1, 1] + list(range(2, n - 1)) + [0]))
+    nloc = n - dist.num_shard_bits(env.mesh)
+    shard_bytes = 2 * (1 << nloc) * (4 if jnp.zeros(()).dtype == jnp.float32
+                                     else 8)
+
+    def fresh():
+        a = rng.standard_normal((2, 1 << n))
+        a /= np.sqrt((a ** 2).sum())
+        return jax.device_put(jnp.asarray(a), env.amp_sharding())
+
+    def run_gate(c):
+        a = fresh()
+        for _ in range(reps):
+            a = dist.apply_matrix_1q_sharded(
+                a, m, mesh=env.mesh, num_qubits=n, target=n - 1, chunks=c)
+        a.block_until_ready()
+        return a
+
+    def run_swap(c):
+        a = fresh()
+        for _ in range(reps):
+            a = dist.swap_sharded(a, mesh=env.mesh, num_qubits=n,
+                                  qb_low=0, qb_high=n - 1, chunks=c)
+        a.block_until_ready()
+        return a
+
+    def run_remap(c):
+        a = fresh()
+        for _ in range(reps):
+            a = dist.remap_sharded(a, mesh=env.mesh, num_qubits=n,
+                                   sigma=sigma, chunks=(c, c))
+        a.block_until_ready()
+        return a
+
+    def permute_count(c):
+        jfn = jax.jit(lambda a: dist.apply_matrix_1q_sharded(
+            a, m, mesh=env.mesh, num_qubits=n, target=n - 1, chunks=c),
+            donate_argnums=0)
+        txt = jfn.lower(fresh()).compile().as_text()
+        return (txt.count(" collective-permute(")
+                + txt.count(" collective-permute-start("))
+
+    sweep = {}
+    compile_s = 0.0
+    for c in (1, 2, 4, 8):
+        gate_s, _, cs = _time_best(lambda c=c: run_gate(c))
+        swap_s, _, _ = _time_best(lambda c=c: run_swap(c))
+        remap_s, _, _ = _time_best(lambda c=c: run_remap(c))
+        if c == 1:
+            compile_s = cs
+            mono = gate_s
+        sweep[f"C{c}"] = {
+            "gate_s": round(gate_s, 4), "swap_s": round(swap_s, 4),
+            "remap_s": round(remap_s, 4),
+            "gate_permute_dispatches": permute_count(c) * reps,
+        }
+    auto = dist.exchange_chunks(shard_bytes)
+    auto_s, _, _ = _time_best(lambda: run_gate(None))
+    _set_compile(compile_s)
+    _emit(7, f"{n}q 8-shard pipelined-exchange wall-clock (auto C={auto})",
+          auto_s, "seconds", auto_s,
+          {"monolithic_seconds": mono,
+           "auto_over_monolithic": round(auto_s / mono, 3),
+           "chunk_sweep": sweep,
+           "shard_bytes": shard_bytes,
+           "remap_exchange_bytes_per_shard": CIRC.remap_exchange_bytes(
+               sigma, n, nloc),
+           "pipeline_min_bytes": dist.PIPELINE_MIN_BYTES})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6}
+           6: config6, 7: config7}
 
 
 def main():

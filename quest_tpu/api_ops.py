@@ -662,7 +662,14 @@ def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg, facOut, out: Qure
             [complex(facOut).imag, complex(fac1).imag, complex(fac2).imag],
         ]
     )
-    out.amps = K.set_weighted_qureg(out.amps, qureg1.amps, qureg2.amps, facs)
+    if out is qureg1 or out is qureg2:
+        # aliased call (out doubles as an input): donating out would hand
+        # XLA a buffer that is also a live argument — keep the copy
+        out.amps = K.set_weighted_qureg(
+            out.amps, qureg1.amps, qureg2.amps, facs)
+    else:
+        out.amps = K.set_weighted_qureg_donated(
+            out.amps, qureg1.amps, qureg2.amps, facs)
 
 
 def _apply_matrix_raw(qureg: Qureg, m, targets, controls=()):

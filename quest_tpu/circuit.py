@@ -1267,6 +1267,28 @@ def plan_circuit_windowed(gates: Sequence[Gate],
 _REMAP_LOOKAHEAD = 256  # next-use horizon for the eviction choice
 
 
+def remap_exchange_bytes(sigma: Tuple[int, ...], num_qubits: int, nloc: int,
+                         itemsize: int = 8) -> int:
+    """ICI bytes ONE shard exchanges executing the batched remap ``sigma``
+    — the scheduling-layer cost model for a window relocalization: each
+    mixed local<->mesh transposition moves half the shard
+    (dist._swap_halves_in_shard), a residual composed mesh permutation
+    moves the whole shard, and the per-shard axis permutation moves
+    nothing over ICI.  Used by bench_suite config 7's exchange-volume
+    accounting and by the pipelined-exchange tests to size the expected
+    chunk payloads (each listed payload is what dist.exchange_chunks
+    splits)."""
+    from .parallel import dist as PAR
+
+    r = num_qubits - nloc
+    mixed, _local_perm, mesh_tau = PAR.decompose_sigma(sigma, nloc, r)
+    shard = 2 * (1 << nloc) * itemsize          # SoA: re + im planes
+    total = len(mixed) * (shard // 2)
+    if mesh_tau is not None:
+        total += shard
+    return total
+
+
 def plan_remap_windows(bit_sets: Sequence[Tuple[int, ...]], num_qubits: int,
                        nloc: int, perm=None):
     """Relocalization pass for a SHARDED register: group a LOGICAL item

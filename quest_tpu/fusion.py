@@ -246,9 +246,15 @@ def _run(qureg, items) -> None:
             _plan_cache[key] = (program, arrays, final_perm)
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
+    if nsh:
+        from .parallel import dist as PAR
+
+        exchange_key = PAR.exchange_config_key()
+    else:
+        exchange_key = None
     runner = _plan_runner(nloc, program,
                           qureg.env.mesh if nsh else None,
-                          _fused.matmul_precision_name())
+                          _fused.matmul_precision_name(), exchange_key)
     # bypass the amps property (which would re-enter drain); the live
     # permutation the windowed plan leaves behind is carried on the
     # register — the next drain starts from it, the next READ
@@ -262,12 +268,17 @@ def _run(qureg, items) -> None:
 
 
 @lru_cache(maxsize=256)
-def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None):
+def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
+                 exchange_key: str = None):
     """Jitted whole-program executor over ("plan", skeleton, n_arrays) /
     ("chan", kind, t, b) parts in order.  For a sharded register the
     program (all items shard-local by capture policy) runs inside ONE
     shard_map over the amplitude mesh — the multi-chip analogue of the
-    drain."""
+    drain.  ``exchange_key`` is dist.exchange_config_key(): the remap
+    parts bake the pipelined-exchange chunk count in at trace time, so
+    the compiled executor must be keyed on the QT_EXCHANGE_CHUNKS
+    override (a stale cache entry would silently keep the old chunk
+    schedule)."""
     from .ops import density as _density
 
     if mesh is not None:

@@ -839,7 +839,22 @@ def collapse_density(amps, prob, *, num_qubits: int, target: int, outcome: int):
 def set_weighted_qureg(amps_out, amps1, amps2, facs):
     """out = f1*q1 + f2*q2 + fOut*out (reference setWeightedQureg,
     QuEST_cpu.c:3965-4006).  ``facs`` is stacked (2, 3): the three complex
-    factors (fOut, f1, f2).  Not donated: callers may alias out with q1/q2."""
+    factors (fOut, f1, f2).  Not donated: callers may alias out with
+    q1/q2 (donating a buffer that is ALSO passed as another live argument
+    is undefined); the API layer routes the common non-aliased case
+    through set_weighted_qureg_donated instead."""
+    out = cplx.cmul(amps_out, facs[0, 0], facs[1, 0])
+    out = out + cplx.cmul(amps1, facs[0, 1], facs[1, 1])
+    out = out + cplx.cmul(amps2, facs[0, 2], facs[1, 2])
+    return out
+
+
+@partial(jax.jit, donate_argnums=0)
+def set_weighted_qureg_donated(amps_out, amps1, amps2, facs):
+    """set_weighted_qureg with ``out`` donated — the in-place form for the
+    (typical) call where ``out`` is a distinct register from q1/q2, saving
+    one full state of HBM on the three-register combine (donation audit,
+    tests/test_donation.py)."""
     out = cplx.cmul(amps_out, facs[0, 0], facs[1, 0])
     out = out + cplx.cmul(amps1, facs[0, 1], facs[1, 1])
     out = out + cplx.cmul(amps2, facs[0, 2], facs[1, 2])

@@ -25,7 +25,12 @@ Two structural wins over the reference: no pairStateVec — the reference
 permanently holds a 2x receive buffer (QuEST_cpu.c:1279-1315) while
 ppermute's transient buffer exists only inside one fused program; and the
 elementwise combine fuses with the communication epilogue under XLA instead
-of being a second pass over memory.
+of being a second pass over memory.  A third (round-8): every exchange is
+CHUNK-PIPELINED — ``exchange_pipelined`` splits the payload into C chunks
+and issues the ppermute for chunk i+1 before the combine consuming chunk
+i, overlapping ICI transfer with VPU work and shrinking the transient
+recv buffer to one chunk (qHiPSTER's pipelined exchange,
+arXiv:1601.07195 §III; docs/design.md §17).
 
 These kernels are *compile-time* alternatives invoked by the API layer when
 a gate touches sharded qubits (quest_tpu.api routes there); the GSPMD path
@@ -37,8 +42,9 @@ a gate touches sharded qubits (quest_tpu.api routes there); the GSPMD path
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +79,177 @@ def lazy_remap_enabled() -> bool:
     return _CONFIG["lazy_remap"]
 
 
+# ---------------------------------------------------------------------------
+# Pipelined chunked exchange (communication/computation overlap)
+#
+# Every sharded-qubit op below used to move its data in ONE monolithic
+# ppermute — the ICI link idle while the combine math ran, the VPU idle
+# while amplitudes were in flight, and the transient recv buffer a full
+# extra shard of HBM.  qHiPSTER (arXiv:1601.07195 §III) gets most of its
+# distributed speedup from splitting the exchange into chunks and
+# pipelining communication with computation; the reference itself chunks
+# its MPI exchange when buffers are tight, without overlapping
+# (exchangeStateVectors, QuEST_cpu_distributed.c:489-517).
+# exchange_pipelined is the shared engine: the payload splits into C
+# chunks along the amplitude axis and the loop is software-pipelined —
+# the ppermute for chunk i+1 is issued BEFORE the combine consuming
+# chunk i (an unrolled two-stage schedule with explicit prologue and
+# epilogue), so XLA's latency-hiding scheduler lowers each exchange to a
+# collective-permute-start/done pair with the previous chunk's combine
+# between them, and the transient recv buffer is one chunk instead of
+# the whole payload (docs/design.md §17).
+# ---------------------------------------------------------------------------
+
+_EXCHANGE_ENV = "QT_EXCHANGE_CHUNKS"
+
+# Small-shard fallback: below this many payload bytes the monolithic
+# exchange wins — per-chunk dispatch/slicing overhead exceeds any
+# overlap.  Measured on the 8-shard CPU dryrun (bench_suite config 7
+# chunk sweep, docs/design.md §17): C=4 costs a steady 21-41% over
+# monolithic across 16 KiB..4 MiB shards when there is NO asynchrony to
+# recoup it (the CPU backend's collective-permute is a synchronous
+# copy), which is why the auto heuristic only engages off-CPU at all;
+# there, the overhead side bounds the loss and the threshold sits where
+# a shard's transfer time is worth hiding (~2 MiB at v5e ICI rates).
+PIPELINE_MIN_BYTES = 1 << 21
+
+# Steady-state chunk sizing: big enough that per-chunk collective setup
+# amortizes, small enough that two in-flight chunks hide under a combine.
+_TARGET_CHUNK_BYTES = 1 << 22
+
+MAX_EXCHANGE_CHUNKS = 8
+
+
+def exchange_config_key() -> Optional[str]:
+    """The live ``QT_EXCHANGE_CHUNKS`` override — a cache-key component
+    for programs that bake the chunk count in at trace time
+    (fusion._plan_runner keys its compiled drain executor on this, so
+    flipping the env var between drains retraces instead of silently
+    reusing a stale chunk schedule)."""
+    return os.environ.get(_EXCHANGE_ENV)
+
+
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(int(x), 1).bit_length() - 1)
+
+
+def exchange_chunks(payload_bytes: int, limit: int = 1 << 30,
+                    backend: Optional[str] = None) -> int:
+    """Chunk count for one exchange of ``payload_bytes`` bytes.
+
+    ``QT_EXCHANGE_CHUNKS`` overrides unconditionally (rounded down to a
+    power of two — chunks must divide the power-of-two payload — with the
+    rounding recorded once in the degradation registry); otherwise the
+    heuristic: monolithic on the CPU backend (its collective-permute is
+    a synchronous copy — chunking measured a flat 21-41% loss with no
+    overlap to recoup, bench_suite config 7) and monolithic below
+    PIPELINE_MIN_BYTES (pipeline overhead loses on small shards), else
+    ~_TARGET_CHUNK_BYTES chunks capped at MAX_EXCHANGE_CHUNKS.
+    ``limit`` is the structural cap of the call site (the payload axis
+    the combine must keep intact); always respected.  ``backend``
+    defaults to the live jax backend (tests pass it explicitly)."""
+    limit = max(1, _pow2_floor(limit))
+    override = exchange_config_key()
+    if override is not None:
+        try:
+            c = max(1, int(override))
+        except ValueError:
+            from .. import resilience
+
+            resilience.record_degradation(
+                "exchange_chunks",
+                f"unparseable {_EXCHANGE_ENV}={override!r}; monolithic")
+            return 1
+        if c != _pow2_floor(c):
+            from .. import resilience
+
+            resilience.record_degradation(
+                "exchange_chunks",
+                f"{_EXCHANGE_ENV}={c} not a power of two; "
+                f"using {_pow2_floor(c)}")
+        return min(_pow2_floor(c), limit)
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "cpu" or payload_bytes < PIPELINE_MIN_BYTES:
+        return 1
+    c = _pow2_floor(payload_bytes // _TARGET_CHUNK_BYTES)
+    return max(1, min(c, MAX_EXCHANGE_CHUNKS, limit))
+
+
+def _shard_payload_bytes(amps, mesh: Mesh) -> int:
+    """Bytes of ONE shard of a (2, N)-global SoA state — the full-shard
+    exchange payload (wrappers resolve chunk counts OUTSIDE the jit so
+    the env override participates in dispatch, not in a stale trace)."""
+    return 2 * (int(amps.shape[-1]) // amp_axis_size(mesh)) * amps.dtype.itemsize
+
+
+def exchange_pipelined(send, perm, combine_fn, *, chunks: int):
+    """Chunked double-buffered ppermute INSIDE a shard_map body.
+
+    Splits ``send`` into ``chunks`` equal contiguous pieces along its
+    LAST axis (= the top log2(chunks) bits of the per-shard amplitude
+    index) and software-pipelines the exchange:
+
+        prologue : ppermute chunk 0
+        steady   : ppermute chunk i+1; combine chunk i   (i = 0..C-2)
+        epilogue : combine chunk C-1
+
+    The loop is fully unrolled so every chunk gets its own HLO
+    collective-permute — the form XLA's latency-hiding scheduler splits
+    into start/done pairs with the neighbouring combine scheduled between
+    them — and the transient recv footprint is at most two chunks (the
+    one being consumed plus the one in flight) instead of the whole
+    payload.  ``combine_fn(i, own_chunk, recv_chunk)`` receives the
+    STATIC chunk index, so call sites can resolve chunk-constant bit
+    conditions (e.g. high local controls) at trace time.
+
+    ``chunks`` <= 1 (or a non-dividing count) is the monolithic path:
+    one ppermute, one combine — bit-identical output either way, since
+    the combines are elementwise on disjoint chunks."""
+    m = int(send.shape[-1])
+    if chunks <= 1 or m % chunks or m // chunks == 0:
+        recv = lax.ppermute(send, AMP_AXIS, perm)
+        return combine_fn(0, send, recv)
+    step = m // chunks
+    parts = jnp.split(send, chunks, axis=-1)
+    in_flight = lax.ppermute(parts[0], AMP_AXIS, perm)     # prologue
+    out = send
+    zeros = (0,) * (send.ndim - 1)
+    for i in range(chunks):
+        recv = in_flight
+        if i + 1 < chunks:
+            # issue chunk i+1 before consuming chunk i: the combine below
+            # is what the transfer hides behind
+            in_flight = lax.ppermute(parts[i + 1], AMP_AXIS, perm)
+        # update-slice chain rather than a concat: a concat epilogue costs
+        # a second full-payload staging buffer (measured on the CPU
+        # dryrun), the chain lets buffer assignment grow the output in
+        # place once the source chunks are dead
+        out = lax.dynamic_update_slice(
+            out, combine_fn(i, parts[i], recv), zeros + (i * step,))
+    return out
+
+
+def _swap_halves_in_shard(local, lb: int, mb: int, nloc: int, ndev: int,
+                          chunks: int = 1):
+    """Half-shard SWAP exchange inside a shard_map body: send the local
+    half whose bit ``lb`` mismatches this shard's mesh bit ``mb`` to the
+    XOR partner and splice the received half back (the reference's
+    'pair processes only swap half their amps', statevec_swapQubitAmps,
+    QuEST_cpu_distributed.c:1397-1436), with the half-payload exchange
+    chunk-pipelined.  Shared by swap_sharded, _remap_in_shard's mixed
+    transpositions, and _reverse_run_sharded."""
+    idx = lax.axis_index(AMP_AXIS)
+    u = (idx >> mb) & 1
+    lv = local.reshape(2, 1 << (nloc - 1 - lb), 2, 1 << lb)
+    send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
+    recv = exchange_pipelined(
+        send.reshape(2, -1), _hypercube_perm(ndev, mb),
+        lambda i, own, rv: rv, chunks=chunks)
+    return lax.dynamic_update_index_in_dim(
+        lv, recv.reshape(send.shape), 1 - u, axis=2).reshape(2, -1)
+
+
 def amp_axis_size(mesh: Mesh) -> int:
     """Size of the amplitude axis — NOT mesh.devices.size: meshes may carry
     extra axes (e.g. the (dp, amps) training mesh)."""
@@ -102,11 +279,6 @@ def _shard_coeffs(rmat_like, mybit):
     return a_re, a_im, b_re, b_im
 
 
-@partial(
-    jax.jit,
-    static_argnames=("mesh", "num_qubits", "target", "controls", "control_states"),
-    donate_argnums=0,
-)
 def apply_matrix_1q_sharded(
     amps,
     matrix,
@@ -116,16 +288,46 @@ def apply_matrix_1q_sharded(
     target: int,
     controls: Tuple[int, ...] = (),
     control_states: Tuple[int, ...] = (),
+    chunks: Optional[int] = None,
 ):
-    """One-qubit dense gate on a *sharded* target qubit: full-shard ppermute
-    exchange + fused elementwise combine — the reference's non-local gate
-    pattern (QuEST_cpu_distributed.c:854-928).
+    """One-qubit dense gate on a *sharded* target qubit: full-shard
+    chunk-pipelined ppermute exchange + fused elementwise combine — the
+    reference's non-local gate pattern (QuEST_cpu_distributed.c:854-928)
+    with the exchange split into chunks so the ICI transfer of chunk i+1
+    overlaps the VPU combine of chunk i (exchange_pipelined).
 
     Low (local) controls restrict the exchanged+combined sub-block; sharded
     controls become a per-shard mask (the reference instead skips ranks
     whose chunk fails the control condition, :1093-1112 — SPMD cannot skip,
     but masked shards do no extra communication since the exchange is
-    collective anyway)."""
+    collective anyway).  ``chunks`` defaults to the per-op heuristic
+    (exchange_chunks over the shard bytes); resolved HERE, outside the
+    jit, so the env override acts at dispatch time."""
+    if chunks is None:
+        chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    return _apply_matrix_1q_sharded(
+        amps, matrix, mesh=mesh, num_qubits=num_qubits, target=target,
+        controls=tuple(controls), control_states=tuple(control_states),
+        chunks=int(chunks))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "num_qubits", "target", "controls",
+                     "control_states", "chunks"),
+    donate_argnums=0,
+)
+def _apply_matrix_1q_sharded(
+    amps,
+    matrix,
+    *,
+    mesh: Mesh,
+    num_qubits: int,
+    target: int,
+    controls: Tuple[int, ...],
+    control_states: Tuple[int, ...],
+    chunks: int,
+):
     ndev = amp_axis_size(mesh)
     r = num_shard_bits(mesh)
     n = num_qubits
@@ -137,29 +339,44 @@ def apply_matrix_1q_sharded(
     states = control_states or (1,) * len(controls)
     local_controls = tuple((c, s) for c, s in zip(controls, states) if c < nloc)
     shard_controls = tuple((c - nloc, s) for c, s in zip(controls, states) if c >= nloc)
+    # power-of-two, never more chunks than per-shard amplitudes: the
+    # chunk-index bit arithmetic below must agree with the engine's split
+    chunks = min(_pow2_floor(chunks), 1 << nloc)
+    c_bits = chunks.bit_length() - 1
+    nch = nloc - c_bits          # local index bits inside one chunk
 
     def kernel(local, m):
         # local: (2, amps_per_shard); m: (2, 2, 2) stacked SoA
         idx = lax.axis_index(AMP_AXIS)
         mybit = (idx >> bit) & 1
-        recv = lax.ppermute(local, AMP_AXIS, perm)
         a_re, a_im, b_re, b_im = _shard_coeffs(m, mybit)
 
-        def combine(own_block, recv_block):
+        def cm(own_block, recv_block):
             return cplx.cmul(own_block, a_re, a_im) + cplx.cmul(recv_block, b_re, b_im)
 
-        if local_controls:
-            shape, sel = kernels._interleaved_sel(nloc, local_controls)
-            lv = local.reshape(shape)
-            rv = recv.reshape(shape)
-            new = lv.at[sel].set(combine(lv[sel], rv[sel]))
-            new = new.reshape(2, -1)
-        else:
-            new = combine(local, recv)
-        for cbit, s in shard_controls:
-            cond = ((idx >> cbit) & 1) == s
-            new = jnp.where(cond, new, local)
-        return new
+        def combine(i, own, recv):
+            # local controls at bit >= nch are chunk-CONSTANT: resolve
+            # them statically from the chunk index (a failing chunk keeps
+            # its own amplitudes — the exchange still moved it, matching
+            # the monolithic kernel's collective-anyway semantics)
+            if any(cb >= nch and ((i >> (cb - nch)) & 1) != s
+                   for cb, s in local_controls):
+                new = own
+            else:
+                low = tuple((cb, s) for cb, s in local_controls if cb < nch)
+                if low:
+                    shape, sel = kernels._interleaved_sel(nch, low)
+                    lv = own.reshape(shape)
+                    rv = recv.reshape(shape)
+                    new = lv.at[sel].set(cm(lv[sel], rv[sel])).reshape(2, -1)
+                else:
+                    new = cm(own, recv)
+            for cbit, s in shard_controls:
+                cond = ((idx >> cbit) & 1) == s
+                new = jnp.where(cond, new, own)
+            return new
+
+        return exchange_pipelined(local, perm, combine, chunks=chunks)
 
     return shard_map(
         kernel,
@@ -169,34 +386,39 @@ def apply_matrix_1q_sharded(
     )(amps, jnp.asarray(matrix, amps.dtype))
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "qb_low", "qb_high"), donate_argnums=0)
-def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int, qb_high: int):
+def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
+                 qb_high: int, chunks: Optional[int] = None):
     """SWAP between a local qubit and a sharded qubit: exchange only the
     mismatched half-shard with the XOR partner (statevec_swapQubitAmps
     routing, QuEST_cpu_distributed.c:1397-1436: 'pair processes only swap
-    half their amps').
+    half their amps'), the half-payload chunk-pipelined
+    (_swap_halves_in_shard -> exchange_pipelined).
 
     Derivation: for shard-coordinate bit u (the high qubit's value) and
     local bit v (the low qubit), elements with v == u stay; elements with
     v != u land on the pair rank at local bit position unchanged-in-value.
     So each shard sends its v = 1-u half and splices the received half back
     at the same position."""
+    if chunks is None:
+        chunks = exchange_chunks(_shard_payload_bytes(amps, mesh) // 2)
+    return _swap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
+                         qb_low=qb_low, qb_high=qb_high, chunks=int(chunks))
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "num_qubits", "qb_low", "qb_high", "chunks"),
+         donate_argnums=0)
+def _swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
+                  qb_high: int, chunks: int):
     ndev = amp_axis_size(mesh)
     r = num_shard_bits(mesh)
     nloc = num_qubits - r
     assert qb_high >= nloc and qb_low < nloc
     bit = qb_high - nloc
-    perm = _hypercube_perm(ndev, bit)
+    chunks = min(_pow2_floor(chunks), 1 << (nloc - 1))
 
     def kernel(local):
-        idx = lax.axis_index(AMP_AXIS)
-        u = (idx >> bit) & 1
-        lv = local.reshape(2, 1 << (nloc - 1 - qb_low), 2, 1 << qb_low)
-        # dynamic half-selection: take(lv, 1-u) along the low-qubit axis
-        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
-        recv = lax.ppermute(send, AMP_AXIS, perm)
-        new = lax.dynamic_update_index_in_dim(lv, recv, 1 - u, axis=2)
-        return new.reshape(2, -1)
+        return _swap_halves_in_shard(local, qb_low, bit, nloc, ndev, chunks)
 
     return shard_map(
         kernel, mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(None, AMP_AXIS)
@@ -250,22 +472,32 @@ def _pair_channel_weights(kind: str, p, ktv, btv, dt):
     return w1, w2
 
 
-@partial(jax.jit,
-         static_argnames=("mesh", "num_qubits", "target", "kind"),
-         donate_argnums=0)
 def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
-                             target: int, kind: str):
+                             target: int, kind: str,
+                             chunks: Optional[int] = None):
     """Explicit distributed depolarise / damping on a sharded density
-    matrix: ONE full-shard ppermute to the double-flip partner + a fused
-    elementwise combine — the TPU-native redesign of the reference's
-    pack-and-exchange distributed decoherence
+    matrix: one chunk-pipelined full-shard ppermute to the double-flip
+    partner + a fused elementwise combine — the TPU-native redesign of the
+    reference's pack-and-exchange distributed decoherence
     (QuEST_cpu_distributed.c:553-852).  GSPMD compiles the same channel to
     3 collective-permutes (depol) or 3 permutes + 10 all-to-alls
-    (damping); this path is exactly one collective.
+    (damping); this path is exactly one (chunked) collective.
 
     ``kind``: "depol" | "damping".  Requires the bra target bit
     (target + num_qubits) to be a mesh-coordinate bit; local-bra channels
     take the elementwise kernels (ops/density.py)."""
+    if chunks is None:
+        chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    return _mix_pair_channel_sharded(
+        amps, prob, mesh=mesh, num_qubits=num_qubits, target=target,
+        kind=kind, chunks=int(chunks))
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "num_qubits", "target", "kind", "chunks"),
+         donate_argnums=0)
+def _mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
+                              target: int, kind: str, chunks: int):
     nq = num_qubits
     nn = 2 * nq
     ndev = amp_axis_size(mesh)
@@ -275,28 +507,38 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     assert b >= nloc, "local channels take ops/density.py"
     bbit = b - nloc
     dt = amps.dtype
+    # the bra-sharded/ket-local branch flips the local ket-bit axis inside
+    # each chunk: chunk bits must stay strictly above it
+    limit = (1 << nloc) if t >= nloc else (1 << (nloc - 1 - t))
+    chunks = min(_pow2_floor(chunks), limit)
 
     def kernel(local, p):
         idx = lax.axis_index(AMP_AXIS)
         btv = (idx >> bbit) & 1
         if t >= nloc:
-            # both target bits sharded: partner shard = double XOR
+            # both target bits sharded: partner shard = double XOR;
+            # weights are per-shard scalars, the combine chunks freely
             tbit = t - nloc
             perm = [(i, i ^ (1 << bbit) ^ (1 << tbit)) for i in range(ndev)]
-            recv = lax.ppermute(local, AMP_AXIS, perm)
             ktv = (idx >> tbit) & 1
             w1, w2 = _pair_channel_weights(kind, p, ktv, btv, dt)
-            return local * w1 + recv * w2
+            return exchange_pipelined(
+                local, perm, lambda i, own, rv: own * w1 + rv * w2,
+                chunks=chunks)
         # ket bit local, bra bit sharded: exchange on the bra mesh bit,
         # partner element = received block with the LOCAL ket bit flipped
         perm = _hypercube_perm(ndev, bbit)
-        recv = lax.ppermute(local, AMP_AXIS, perm)
-        shape = (2, 1 << (nloc - 1 - t), 2, 1 << t)
-        v = local.reshape(shape)
-        pv = jnp.flip(recv.reshape(shape), axis=2)
-        ktv = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 2, 1), 2)
-        w1, w2 = _pair_channel_weights(kind, p, ktv, btv, dt)
-        return (v * w1 + pv * w2).reshape(local.shape)
+        hi_per_chunk = (1 << (nloc - 1 - t)) // chunks
+
+        def combine(i, own, rv):
+            shape = (2, hi_per_chunk, 2, 1 << t)
+            v = own.reshape(shape)
+            pv = jnp.flip(rv.reshape(shape), axis=2)
+            ktv = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 2, 1), 2)
+            w1, w2 = _pair_channel_weights(kind, p, ktv, btv, dt)
+            return (v * w1 + pv * w2).reshape(own.shape)
+
+        return exchange_pipelined(local, perm, combine, chunks=chunks)
 
     return shard_map(
         kernel, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
@@ -304,10 +546,10 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     )(amps, jnp.asarray(prob, dt))
 
 
-def _apply_1q_mesh_bit(local, m, bit: int, ndev: int):
+def _apply_1q_mesh_bit(local, m, bit: int, ndev: int, chunks: int = 1):
     """Dense 1q gate on mesh-coordinate bit ``bit`` INSIDE a shard_map body:
-    one full-shard ppermute + fused elementwise combine — the
-    apply_matrix_1q_sharded kernel body factored out so scan-based
+    one chunk-pipelined full-shard ppermute + fused elementwise combine —
+    the apply_matrix_1q_sharded kernel body factored out so scan-based
     composites (Trotter, PauliSum expectation) can apply rotation layers
     to sharded qubits with the same exchange pattern the reference's
     distributed compactUnitary uses (QuEST_cpu_distributed.c:854-928).
@@ -317,9 +559,11 @@ def _apply_1q_mesh_bit(local, m, bit: int, ndev: int):
     basis rotations also exchange regardless of the rotation angle."""
     idx = lax.axis_index(AMP_AXIS)
     mybit = (idx >> bit) & 1
-    recv = lax.ppermute(local, AMP_AXIS, _hypercube_perm(ndev, bit))
     a_re, a_im, b_re, b_im = _shard_coeffs(m, mybit)
-    return cplx.cmul(local, a_re, a_im) + cplx.cmul(recv, b_re, b_im)
+    return exchange_pipelined(
+        local, _hypercube_perm(ndev, bit),
+        lambda i, own, rv: cplx.cmul(own, a_re, a_im) + cplx.cmul(rv, b_re, b_im),
+        chunks=chunks)
 
 
 def _split_parity_mask(zlo, zhi, nloc: int, r: int):
@@ -364,23 +608,36 @@ def _parity_phase_sharded(local, theta, zlo, zhi, nloc: int, r: int):
     return cplx.cmul(local, jnp.cos(ang), jnp.sin(ang) * s_sh * s_loc)
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "rep_qubits"),
-         donate_argnums=0)
 def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
-                         num_qubits: int, rep_qubits: int):
+                         num_qubits: int, rep_qubits: int,
+                         chunks: Optional[int] = None):
     """The whole Trotter gate stream on a SHARDED register as ONE
     shard_map(lax.scan) program — the same one-compiled-term-body design
     as ops/paulis.trotter_scan, with the per-term basis-rotation layers
     applying local qubits through the per-shard window kernels and
-    mesh-coordinate qubits through explicit ppermute exchange
-    (_apply_1q_mesh_bit), and the parity phase split into local x
-    per-shard-scalar signs.  This makes the one-kernel-set contract
-    (QuEST_internal.h:63-292) hold for applyTrotterCircuit on real
-    multi-chip meshes: the reference's agnostic_applyTrotterCircuit
-    (QuEST_common.c:752-834) likewise rides the same distributed kernels.
+    mesh-coordinate qubits through chunk-pipelined ppermute exchange
+    (_apply_1q_mesh_bit -> exchange_pipelined), and the parity phase
+    split into local x per-shard-scalar signs.  This makes the
+    one-kernel-set contract (QuEST_internal.h:63-292) hold for
+    applyTrotterCircuit on real multi-chip meshes: the reference's
+    agnostic_applyTrotterCircuit (QuEST_common.c:752-834) likewise rides
+    the same distributed kernels.
 
-    Collectives: exactly 2*r ppermutes per scanned term (rotate +
-    unrotate layer, one per sharded qubit), nothing else."""
+    Collectives: exactly 2*r*C ppermutes per scanned term (rotate +
+    unrotate layer, one chunked exchange per sharded qubit), nothing
+    else."""
+    if chunks is None:
+        chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    return _trotter_scan_sharded(
+        amps, codes_seq, angles, mesh=mesh, num_qubits=num_qubits,
+        rep_qubits=rep_qubits, chunks=int(chunks))
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "num_qubits", "rep_qubits", "chunks"),
+         donate_argnums=0)
+def _trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
+                          num_qubits: int, rep_qubits: int, chunks: int):
     from ..ops import paulis as _paulis
 
     n, nq = num_qubits, rep_qubits
@@ -388,11 +645,13 @@ def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
     r = num_shard_bits(mesh)
     nloc = n - r
     dt = amps.dtype
+    chunks = min(_pow2_floor(chunks), 1 << nloc)
 
     def layer(local, mats):
         local = _paulis._product_layer(local, mats[:nloc], nloc)
         for q in range(nloc, n):
-            local = _apply_1q_mesh_bit(local, mats[q], q - nloc, ndev)
+            local = _apply_1q_mesh_bit(local, mats[q], q - nloc, ndev,
+                                       chunks)
         return local
 
     def kernel(local, codes_seq, angles):
@@ -411,18 +670,28 @@ def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
     )(amps, codes_seq, angles)
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "quad"))
 def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
-                                 num_qubits: int, quad: bool = False):
+                                 num_qubits: int, quad: bool = False,
+                                 chunks: Optional[int] = None):
     """Re <psi| sum_t c_t P_t |psi> on a SHARDED statevector as ONE
     shard_map(lax.scan) — the sharded form of
     ops/paulis.expec_pauli_sum_scan: per term, basis-rotate per shard
-    (ppermute for sharded qubits), reduce the parity-signed norm locally
-    with the shard-scalar sign factored out, and psum ONCE at the end
-    (the reference's local-reduce + MPI_Allreduce,
-    QuEST_cpu_distributed.c:35-51).
+    (chunk-pipelined ppermute for sharded qubits), reduce the
+    parity-signed norm locally with the shard-scalar sign factored out,
+    and psum ONCE at the end (the reference's local-reduce +
+    MPI_Allreduce, QuEST_cpu_distributed.c:35-51).
 
-    Collectives: r ppermutes per scanned term + one all-reduce total."""
+    Collectives: r*C ppermutes per scanned term + one all-reduce total."""
+    if chunks is None:
+        chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    return _expec_pauli_sum_scan_sharded(
+        amps, codes_seq, coeffs, mesh=mesh, num_qubits=num_qubits,
+        quad=quad, chunks=int(chunks))
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "quad", "chunks"))
+def _expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
+                                  num_qubits: int, quad: bool, chunks: int):
     from ..ops import paulis as _paulis
 
     n = num_qubits
@@ -430,11 +699,12 @@ def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
     r = num_shard_bits(mesh)
     nloc = n - r
     dt = amps.dtype
+    chunks = min(_pow2_floor(chunks), 1 << nloc)
 
     def layer(local, mats):
         phi = _paulis._product_layer(local, mats[:nloc], nloc)
         for q in range(nloc, n):
-            phi = _apply_1q_mesh_bit(phi, mats[q], q - nloc, ndev)
+            phi = _apply_1q_mesh_bit(phi, mats[q], q - nloc, ndev, chunks)
         return phi
 
     def signed_norm(phi, zlo, zhi):
@@ -757,13 +1027,9 @@ def _reverse_run_sharded(local, base: int, count: int, nloc: int,
         local = lax.ppermute(local, AMP_AXIS,
                              [(i, sig(i)) for i in range(ndev)])
     for lb, mb in mixed:
-        idx = lax.axis_index(AMP_AXIS)
-        u = (idx >> mb) & 1
-        lv = local.reshape(2, 1 << (nloc - 1 - lb), 2, 1 << lb)
-        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
-        recv = lax.ppermute(send, AMP_AXIS, _hypercube_perm(ndev, mb))
-        local = lax.dynamic_update_index_in_dim(
-            lv, recv, 1 - u, axis=2).reshape(2, -1)
+        # QFT bit reversals stay monolithic (chunks=1): the reversal is a
+        # pure relabeling with no combine math to hide the transfer behind
+        local = _swap_halves_in_shard(local, lb, mb, nloc, ndev)
     return local
 
 
@@ -887,22 +1153,28 @@ def decompose_sigma(sigma: Tuple[int, ...], nloc: int, r: int):
     return tuple(mixed), local_perm, mesh_tau
 
 
-def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int):
+def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
+                    chunks: Optional[Tuple[int, int]] = None):
     """Apply the physical bit permutation ``sigma`` INSIDE a shard_map
-    body: the mixed half-shard swaps, then one per-shard axis permutation,
-    then one composed shard-index ppermute (decompose_sigma).  Shared by
+    body: the mixed half-shard swaps (chunk-pipelined), then one per-shard
+    axis permutation, then one composed shard-index ppermute (chunked so
+    its transient recv buffer is one chunk) — decompose_sigma.  Shared by
     the standalone remap_sharded program and the fusion drain's
-    ("remap", sigma) parts."""
+    ("remap", sigma) parts.
+
+    ``chunks``: (half_shard_chunks, full_shard_chunks); None resolves the
+    per-op heuristic from the (static) per-shard payload size at trace
+    time — the drain executor keys its compiled-program cache on
+    exchange_config_key() so an env-override flip retraces."""
     r = int(math.log2(ndev))
     mixed, local_perm, mesh_tau = decompose_sigma(sigma, nloc, r)
+    if chunks is None:
+        nbytes = 2 * (1 << nloc) * local.dtype.itemsize
+        chunks = (exchange_chunks(nbytes // 2), exchange_chunks(nbytes))
+    ch_half = min(_pow2_floor(chunks[0]), 1 << max(nloc - 1, 0))
+    ch_full = min(_pow2_floor(chunks[1]), 1 << nloc)
     for lb, mb in mixed:
-        idx = lax.axis_index(AMP_AXIS)
-        u = (idx >> mb) & 1
-        lv = local.reshape(2, 1 << (nloc - 1 - lb), 2, 1 << lb)
-        send = lax.dynamic_index_in_dim(lv, 1 - u, axis=2, keepdims=False)
-        recv = lax.ppermute(send, AMP_AXIS, _hypercube_perm(ndev, mb))
-        local = lax.dynamic_update_index_in_dim(
-            lv, recv, 1 - u, axis=2).reshape(2, -1)
+        local = _swap_halves_in_shard(local, lb, mb, nloc, ndev, ch_half)
     if local_perm is not None:
         local = kernels.permute_qubits(local, num_qubits=nloc,
                                        perm=local_perm)
@@ -913,28 +1185,40 @@ def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int):
                 j |= ((i >> b) & 1) << t
             return j
 
-        local = lax.ppermute(local, AMP_AXIS,
-                             [(i, dest(i)) for i in range(ndev)])
+        local = exchange_pipelined(
+            local, [(i, dest(i)) for i in range(ndev)],
+            lambda i, own, rv: rv, chunks=ch_full)
     return local
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "sigma"),
-         donate_argnums=0)
 def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
-                  sigma: Tuple[int, ...]):
+                  sigma: Tuple[int, ...],
+                  chunks: Optional[Tuple[int, int]] = None):
     """ONE batched physical-bit permutation of a sharded register: at most
-    (#local<->mesh crossings) half-shard ppermutes + one per-shard axis
-    permutation + one composed full-shard ppermute, regardless of how many
-    gates the window it serves contains.  This is the communication the
-    window planner schedules ONCE per window where the reference pays two
-    half-shard exchanges per sharded-target gate
-    (QuEST_cpu_distributed.c:1447-1545)."""
+    (#local<->mesh crossings) chunk-pipelined half-shard exchanges + one
+    per-shard axis permutation + one composed (chunked) full-shard
+    ppermute, regardless of how many gates the window it serves contains.
+    This is the communication the window planner schedules ONCE per window
+    where the reference pays two half-shard exchanges per sharded-target
+    gate (QuEST_cpu_distributed.c:1447-1545)."""
+    if chunks is None:
+        nbytes = _shard_payload_bytes(amps, mesh)
+        chunks = (exchange_chunks(nbytes // 2), exchange_chunks(nbytes))
+    return _remap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
+                          sigma=tuple(sigma),
+                          chunks=(int(chunks[0]), int(chunks[1])))
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "sigma", "chunks"),
+         donate_argnums=0)
+def _remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
+                   sigma: Tuple[int, ...], chunks: Tuple[int, int]):
     ndev = amp_axis_size(mesh)
     r = num_shard_bits(mesh)
     nloc = num_qubits - r
 
     def kernel(local):
-        return _remap_in_shard(local, sigma, nloc, ndev)
+        return _remap_in_shard(local, sigma, nloc, ndev, chunks)
 
     return shard_map(
         kernel, mesh=mesh, in_specs=P(None, AMP_AXIS),

@@ -37,6 +37,7 @@ def test_respects_user_jax_env_var(monkeypatch):
 def test_cpu_backend_skipped_by_default(monkeypatch):
     monkeypatch.delenv("QT_NO_COMPILE_CACHE", raising=False)
     monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("QT_COMPILE_CACHE", raising=False)
     monkeypatch.delenv("QT_COMPILE_CACHE_DIR", raising=False)
     if jax.config.jax_compilation_cache_dir:
         pytest.skip("cache already configured in this session")
@@ -48,6 +49,7 @@ def test_cpu_backend_skipped_by_default(monkeypatch):
 def test_explicit_dir_forces_on_cpu(monkeypatch, tmp_path):
     monkeypatch.delenv("QT_NO_COMPILE_CACHE", raising=False)
     monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("QT_COMPILE_CACHE", raising=False)
     if jax.config.jax_compilation_cache_dir:
         pytest.skip("cache already configured in this session")
     monkeypatch.setenv("QT_COMPILE_CACHE_DIR", str(tmp_path / "qc"))
@@ -56,3 +58,36 @@ def test_explicit_dir_forces_on_cpu(monkeypatch, tmp_path):
         assert _configured() == str(tmp_path / "qc")
     finally:
         jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_qt_compile_cache_var_wires_and_reports(monkeypatch, tmp_path):
+    """QT_COMPILE_CACHE=<dir> (the canonical spelling; *_DIR kept as an
+    alias) wires the persistent cache anywhere — including CPU — and the
+    hit/miss counters surface through getEnvironmentString."""
+    monkeypatch.delenv("QT_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("QT_COMPILE_CACHE_DIR", raising=False)
+    if jax.config.jax_compilation_cache_dir:
+        pytest.skip("cache already configured in this session")
+    cache_dir = str(tmp_path / "qc2")
+    monkeypatch.setenv("QT_COMPILE_CACHE", cache_dir)
+    try:
+        E._enable_compilation_cache()
+        assert _configured() == cache_dir
+        stats = E.compile_cache_stats()
+        assert stats["dir"] == cache_dir
+        env = qt.createQuESTEnv()
+        s = qt.getEnvironmentString(env)
+        assert f"CompileCache={cache_dir}" in s
+        assert "hits=" in s and "misses=" in s
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        E._CACHE_STATS["dir"] = None
+
+
+def test_environment_string_reports_exchange_config(monkeypatch):
+    env = qt.createQuESTEnv()
+    monkeypatch.delenv("QT_EXCHANGE_CHUNKS", raising=False)
+    assert "ExchangeChunks=auto" in qt.getEnvironmentString(env)
+    monkeypatch.setenv("QT_EXCHANGE_CHUNKS", "4")
+    assert "ExchangeChunks=4" in qt.getEnvironmentString(env)

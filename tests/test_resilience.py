@@ -111,6 +111,26 @@ class TestResumeBitExact:
         qt.run_resumable(q2, _circuit(), ckpt, every=8)
         np.testing.assert_array_equal(np.asarray(q2.amps), reference)
 
+    def test_resume_bit_identical_with_pipelined_exchange(
+            self, env, tmp_path, reference, monkeypatch):
+        """ISSUE 3: the pipelined chunked exchange must not perturb the
+        resume contract.  Snapshots taken mid-stream store RAW permuted
+        amplitudes, whose layout is chunk-INDEPENDENT — the chunk count
+        only reschedules the exchange, it never changes what lands where
+        — so a run killed and resumed under QT_EXCHANGE_CHUNKS=4 stays
+        bit-identical to the unchunked uninterrupted reference."""
+        if env.num_devices < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        monkeypatch.setenv("QT_EXCHANGE_CHUNKS", "4")
+        ckpt = str(tmp_path / "ck")
+        q = _fresh(env)
+        with pytest.raises(qt.SimulatedPreemption):
+            qt.run_resumable(q, _circuit(), ckpt, every=8,
+                             faults=qt.FaultPlan("kill@3"))
+        q2 = _fresh(env)
+        qt.run_resumable(q2, _circuit(), ckpt, every=8)
+        np.testing.assert_array_equal(np.asarray(q2.amps), reference)
+
     def test_checkpoints_at_window_boundaries_only(self, env, tmp_path):
         """One fusion drain per window: a checkpoint can never land
         mid-window (fusion.py drain counter)."""
