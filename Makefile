@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults bench docs clean
+.PHONY: all native test verify verify-faults verify-telemetry bench docs clean
 
 all: native
 
@@ -33,6 +33,13 @@ verify:
 # NaN injection + watchdog policies (quest_tpu/resilience.py).
 verify-faults:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Telemetry layer (quest_tpu/telemetry.py): the unit/integration suite
+# plus the micro-benchmark guard — enabled-mode accounting must cost
+# < 5% over QT_TELEMETRY=off on a 1k-gate fusion drain.
+verify-telemetry:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	python scripts/bench_telemetry.py
 
 bench: native
 	python bench.py

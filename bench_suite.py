@@ -391,8 +391,63 @@ def config7():
            "pipeline_min_bytes": dist.PIPELINE_MIN_BYTES})
 
 
+def config8():
+    """Telemetry-instrumented fused chain (ISSUE 4): runs with
+    QT_TELEMETRY=on and dumps the full metrics snapshot JSON
+    (TELEMETRY_snapshot.json, next to this timing line) so a bench run
+    leaves behind the exchange/window/dispatch accounting of its own
+    workload.  The <5% enabled-mode overhead gate is the separate
+    scripts/bench_telemetry.py guard (make verify-telemetry)."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+
+    n = 10 if CPU else 22
+    depth = 8
+    env = qt.createQuESTEnv()
+    sharded = env.num_devices >= 8 and (1 << n) >= 8 * env.num_devices
+    rng = np.random.default_rng(23)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    prev_mode = telemetry.mode_name()
+    telemetry.configure("on")
+
+    def run():
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            for _ in range(depth):
+                for t in range(n):
+                    qt.hadamard(q, t)
+                qt.multiQubitUnitary(q, [0, 1], u)
+                if sharded:  # exercise the window-remap accounting
+                    qt.multiQubitUnitary(q, [n - 2, n - 1], u)
+        return qt.calcProbOfOutcome(q, 0, 0)
+
+    try:
+        seconds, prob, compile_s = _time_best(run)
+        telemetry.reset()
+        run()  # the snapshot reflects exactly ONE instrumented run
+        snap = telemetry.snapshot()
+        path = os.path.abspath("TELEMETRY_snapshot.json")
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1)
+        _set_compile(compile_s)
+        _emit(8, f"{n}q telemetry-instrumented fused chain", seconds,
+              "seconds", seconds,
+              {"prob": prob, "snapshot_file": path,
+               "exchanges_total": telemetry.counter_total(
+                   "exchanges_total"),
+               "exchange_bytes_total": telemetry.counter_total(
+                   "exchange_bytes_total"),
+               "fusion_windows_total": telemetry.counter_total(
+                   "fusion_windows_total"),
+               "dispatch_total": telemetry.counter_total(
+                   "dispatch_total")})
+    finally:
+        telemetry.configure(prev_mode)
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7}
+           6: config6, 7: config7, 8: config8}
 
 
 def main():

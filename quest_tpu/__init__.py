@@ -57,6 +57,8 @@ from .debug import (
     initStateFromSingleFile,
     compareStates,
 )
+from . import telemetry
+from .telemetry import report_perf as reportPerf, report_perf
 from .ops import phasefunc as _pf
 
 # enum phaseFunc (QuEST.h:231-234)

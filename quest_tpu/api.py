@@ -28,6 +28,7 @@ import numpy as np
 from . import env as _env
 from . import fusion as _fusion
 from . import rng as _rng
+from . import telemetry as _telemetry
 from . import validation as V
 from .ops import calculations as C
 from .ops import cplx as CX
@@ -42,6 +43,14 @@ from .qureg import DiagonalOp, PauliHamil, Qureg
 
 # pauliOpType (QuEST.h:96)
 PAULI_I, PAULI_X, PAULI_Y, PAULI_Z = 0, 1, 2, 3
+
+# per-kernel-family dispatch counters: keys prebuilt once so the per-gate
+# hot-loop cost is one int test + one dict upsert (telemetry.inc_key)
+_K_UNITARY = _telemetry.counter_key("dispatch_total", family="unitary")
+_K_DIAG = _telemetry.counter_key("dispatch_total", family="diag")
+_K_NOT = _telemetry.counter_key("dispatch_total", family="not")
+_K_PARITY = _telemetry.counter_key("dispatch_total", family="parity_phase")
+_K_SWAP = _telemetry.counter_key("dispatch_total", family="swap")
 # bitEncoding (QuEST.h:269)
 UNSIGNED, TWOS_COMPLEMENT = 0, 1
 
@@ -493,6 +502,7 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
             qureg._last_use[b] = qureg._use_clock
         high = [t for t in ptargets if t >= nloc]
         if not high:
+            _telemetry.inc("dispatch_route_total", route="perm_local")
             qureg._set_amps_permuted(
                 K.apply_matrix(
                     amps, stacked, num_qubits=n,
@@ -501,6 +511,7 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
                 perm)
             return
         if len(ptargets) == 1:
+            _telemetry.inc("dispatch_route_total", route="exchange_1q")
             qureg._set_amps_permuted(
                 PAR.apply_matrix_1q_sharded(
                     amps, stacked, mesh=env.mesh, num_qubits=n,
@@ -518,6 +529,7 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
         swaps, new_targets = PAR.plan_relocalization(
             n, nloc, ptargets, pcontrols, free_order=free_order)
         if swaps is not None:
+            _telemetry.inc("dispatch_route_total", route="relocalize")
             for lo, hi in swaps:
                 amps = PAR.swap_sharded(
                     amps, mesh=env.mesh, num_qubits=n, qb_low=lo, qb_high=hi
@@ -548,6 +560,7 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
         # not enough free local qubits to relocalize (the reference
         # REJECTS such ops, QuEST_validation.c:469-471): materialize
         # canonical order and fall through to GSPMD propagation
+    _telemetry.inc("dispatch_route_total", route="default")
     qureg.amps = K.apply_matrix(
         qureg.amps, stacked, num_qubits=n, targets=targets,
         controls=controls, control_states=control_states,
@@ -561,6 +574,7 @@ def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
+    _telemetry.inc_key(_K_UNITARY)
     stacked = CX.soa(matrix)
     if _fusion.capture_unitary(qureg, stacked, targets, controls, control_states):
         return
@@ -585,6 +599,7 @@ def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
+    _telemetry.inc_key(_K_DIAG)
     stacked = CX.soa(diag)
     if _fusion.capture_diag(qureg, stacked, targets, controls, control_states):
         return
@@ -844,6 +859,7 @@ def _apply_not(qureg, targets, controls, control_states=()):
     """NOTs are pure index-bit flips, position-independent — like
     _apply_diag they run at the physical positions of a live
     permutation."""
+    _telemetry.inc_key(_K_NOT)
     if _fusion.capture_not(qureg, targets, controls, control_states):
         return
     amps = qureg._amps_raw()  # drains any pending fusion first
@@ -889,6 +905,7 @@ def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
     QuEST_cpu_distributed.c:1397-1436); canonical order rematerializes on
     the next state read."""
     V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
+    _telemetry.inc_key(_K_SWAP)
     if _fusion.capture_unitary(qureg, _SWAP_SOA, (qubit1, qubit2)):
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
         return
@@ -945,6 +962,7 @@ def multiControlledMultiRotateZ(qureg, controlQubits, targetQubits, angle) -> No
 def _apply_parity_phase(qureg, angle, qubits, controls, conj=False):
     # parity phases are index-derived (elementwise): physical positions
     # of the live permutation, no rematerialization
+    _telemetry.inc_key(_K_PARITY)
     a = -angle if conj else angle
     amps = qureg._amps_raw()  # drains any pending fusion first
     perm = qureg._perm

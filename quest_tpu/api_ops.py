@@ -13,6 +13,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry as _telemetry
 from . import validation as V
 from .ops import calculations as C
 from .ops import density as D
@@ -125,6 +126,7 @@ def measureWithStats(qureg: Qureg, measureQubit: int):
     mode) restores the reference's host-MT sampling stream
     (calcProb -> generateMeasurementOutcome -> collapse)."""
     V.validate_target(qureg, measureQubit, "measureWithStats")
+    _telemetry.inc("measurement_shots_total")
     from .ops import measurement as M
     if M.host_path_enabled():
         zero_prob = calcProbOfOutcome(qureg, measureQubit, 0)
@@ -166,6 +168,8 @@ def measureSequence(qureg: Qureg, qubits: Sequence[int]):
             outs.append(o)
             probs.append(p)
         return outs, probs
+    # (the host path above counts per measureWithStats call)
+    _telemetry.inc("measurement_shots_total", len(qubits))
     key, shot = M.KEYS.next_shots(len(qubits))
     amps, outs, probs = M.measure_sequence(
         qureg.amps, key, shot, num_qubits=qureg.num_qubits_represented,
@@ -675,6 +679,7 @@ def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg, facOut, out: Qure
 def _apply_matrix_raw(qureg: Qureg, m, targets, controls=()):
     from .ops import cplx as CX
 
+    _telemetry.inc("dispatch_total", family="matrix_raw")
     qureg.amps = K.apply_matrix(
         qureg.amps, CX.soa(m), num_qubits=_sv_n(qureg),
         targets=tuple(int(t) for t in targets), controls=tuple(int(c) for c in controls),

@@ -268,6 +268,12 @@ def get_environment_string(env: QuESTEnv) -> str:
     if degraded:
         s += " Degraded=[" + "; ".join(
             f"{k}: {v}" for k, v in sorted(degraded.items())) + "]"
+    # consolidated observability block (telemetry.py absorbs the cache
+    # counters and degradation registry above as series of the same
+    # namespace; the legacy fields stay for compatibility)
+    from . import telemetry
+
+    s += f" [telemetry: {telemetry.summary()}]"
     return s
 
 

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import circuit as C
+from . import telemetry as _telemetry
 from .ops import cplx as _cplx
 
 # largest dense gate (targets + controls) worth buffering; anything bigger
@@ -73,8 +74,11 @@ def drain(qureg) -> None:
     buf = getattr(qureg, "_fusion", None)
     if buf is not None and buf.gates:
         gates, buf.gates = buf.gates, []
+        _telemetry.inc("fusion_drains_total")
+        _telemetry.observe("fusion_drain_gates", len(gates))
         try:
-            _run(qureg, gates)
+            with _telemetry.span("fusion.drain", gates=len(gates)):
+                _run(qureg, gates)
         except BaseException:
             buf.gates = gates + buf.gates
             raise
@@ -141,6 +145,7 @@ def _split_items(items, nloc: int, sweep_ok: bool):
 
     def flush_gates():
         if seg:
+            _telemetry.observe("fusion_window_gates", len(seg))
             ops = C.plan_circuit(list(seg), nloc)
             skeleton, arrs = C.split_plan(ops)
             program.append(("plan", skeleton, len(arrs)))
@@ -192,6 +197,7 @@ def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
     program: List[tuple] = []
     arrays: List[object] = []
     for (i, j), sigma, perm in segments:
+        _telemetry.observe("fusion_remap_window_items", j - i)
         if sigma is not None:
             program.append(("remap", sigma))
         sub = []
@@ -232,18 +238,44 @@ def _run(qureg, items) -> None:
     key = _plan_key(items, nloc, sweep_ok, perm0)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
+        _telemetry.inc("fusion_plan_cache_hits_total")
         program, arrays, final_perm = hit
     else:
-        if nsh:
-            program, arrays, final_perm = _split_items_sharded(
-                items, n, nloc, perm0, sweep_ok)
-        else:
-            program, arrays = _split_items(items, nloc, sweep_ok)
-            final_perm = None
+        _telemetry.inc("fusion_plan_cache_misses_total")
+        with _telemetry.span("fusion.plan", items=len(items)):
+            if nsh:
+                program, arrays, final_perm = _split_items_sharded(
+                    items, n, nloc, perm0, sweep_ok)
+            else:
+                program, arrays = _split_items(items, nloc, sweep_ok)
+                final_perm = None
         if key is not None:
             if len(_plan_cache) >= _PLAN_CACHE_MAX:
                 _plan_cache.pop(next(iter(_plan_cache)))
             _plan_cache[key] = (program, arrays, final_perm)
+    if _telemetry.enabled():
+        _telemetry.inc("fusion_windows_total",
+                       sum(1 for p in program if p[0] == "plan"))
+        if nsh:
+            # window-remap ICI accounting at dispatch time: each
+            # ("remap", sigma) part's per-shard exchange classes and
+            # bytes come from the same cost model the tests pin
+            # (circuit.remap_exchange_bytes / dist.decompose_sigma)
+            from .parallel import dist as PAR
+
+            itemsize = np.dtype(qureg.dtype).itemsize
+            ck = str(PAR.exchange_config_key() or "auto")
+            for part in program:
+                if part[0] != "remap":
+                    continue
+                sigma = part[1]
+                mixed, _lp, mesh_tau = PAR.decompose_sigma(sigma, nloc, nsh)
+                cnt = len(mixed) + (1 if mesh_tau is not None else 0)
+                if cnt:
+                    _telemetry.record_exchange(
+                        "window_remap", cnt,
+                        C.remap_exchange_bytes(sigma, n, nloc, itemsize),
+                        chunks=ck)
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
     if nsh:
@@ -279,6 +311,9 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
     the compiled executor must be keyed on the QT_EXCHANGE_CHUNKS
     override (a stale cache entry would silently keep the old chunk
     schedule)."""
+    # this body runs only on an lru_cache MISS: each execution is a new
+    # compiled-executor shape — the drain's retrace count
+    _telemetry.inc("fusion_retrace_total")
     from .ops import density as _density
 
     if mesh is not None:

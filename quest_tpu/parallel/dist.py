@@ -51,10 +51,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _telemetry
 from ..env import AMP_AXIS, shard_map
 from ..ops import cplx, kernels
 
 _CONFIG = {"explicit": True, "lazy_remap": True}
+
+
+def _record_exchange(amps, op: str, count: int, nbytes: int, chunks) -> None:
+    """Dispatch-time exchange accounting (telemetry.record_exchange):
+    skipped for traced operands — a wrapper reached from inside a user
+    jit body would otherwise count once per TRACE, not per execution."""
+    if not _telemetry.enabled() or isinstance(amps, jax.core.Tracer):
+        return
+    _telemetry.record_exchange(op, count, nbytes, chunks=str(chunks))
 
 
 def use_explicit_dist(enabled: bool) -> None:
@@ -305,6 +315,8 @@ def apply_matrix_1q_sharded(
     jit, so the env override acts at dispatch time."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    _record_exchange(amps, "matrix_1q", 1, _shard_payload_bytes(amps, mesh),
+                     chunks)
     return _apply_matrix_1q_sharded(
         amps, matrix, mesh=mesh, num_qubits=num_qubits, target=target,
         controls=tuple(controls), control_states=tuple(control_states),
@@ -401,6 +413,8 @@ def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
     at the same position."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh) // 2)
+    _record_exchange(amps, "swap", 1, _shard_payload_bytes(amps, mesh) // 2,
+                     chunks)
     return _swap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
                          qb_low=qb_low, qb_high=qb_high, chunks=int(chunks))
 
@@ -438,11 +452,18 @@ def total_prob_sharded(amps, *, mesh: Mesh):
     )(amps)
 
 
-@partial(jax.jit, static_argnames=("mesh",))
 def gather_replicated(amps, *, mesh: Mesh):
     """Replicate the full state onto every device — the analogue of the
     reference's ring-of-broadcasts copyVecIntoMatrixPairState
     (QuEST_cpu_distributed.c:379-423), used to build rho = |psi><psi|."""
+    ndev = amp_axis_size(mesh)
+    _record_exchange(amps, "gather", 1,
+                     _shard_payload_bytes(amps, mesh) * (ndev - 1), 1)
+    return _gather_replicated(amps, mesh=mesh)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _gather_replicated(amps, *, mesh: Mesh):
 
     def kernel(local):
         return lax.all_gather(local, AMP_AXIS, axis=1, tiled=True)
@@ -488,6 +509,8 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     take the elementwise kernels (ops/density.py)."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    _record_exchange(amps, "pair_channel", 1,
+                     _shard_payload_bytes(amps, mesh), chunks)
     return _mix_pair_channel_sharded(
         amps, prob, mesh=mesh, num_qubits=num_qubits, target=target,
         kind=kind, chunks=int(chunks))
@@ -628,6 +651,10 @@ def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
     else."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    nex = 2 * num_shard_bits(mesh) * int(codes_seq.shape[0])
+    if nex:
+        _record_exchange(amps, "trotter", nex,
+                         nex * _shard_payload_bytes(amps, mesh), chunks)
     return _trotter_scan_sharded(
         amps, codes_seq, angles, mesh=mesh, num_qubits=num_qubits,
         rep_qubits=rep_qubits, chunks=int(chunks))
@@ -684,6 +711,10 @@ def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
     Collectives: r*C ppermutes per scanned term + one all-reduce total."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    nex = num_shard_bits(mesh) * int(codes_seq.shape[0])
+    if nex:
+        _record_exchange(amps, "expec", nex,
+                         nex * _shard_payload_bytes(amps, mesh), chunks)
     return _expec_pauli_sum_scan_sharded(
         amps, codes_seq, coeffs, mesh=mesh, num_qubits=num_qubits,
         quad=quad, chunks=int(chunks))
@@ -742,9 +773,6 @@ def _expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
     )(amps, codes_seq, coeffs)
 
 
-@partial(jax.jit,
-         static_argnames=("mesh", "num_qubits", "qubit1", "qubit2"),
-         donate_argnums=0)
 def mix_two_qubit_depol_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
                                 qubit1: int, qubit2: int):
     """Explicit distributed two-qubit depolarising: the double-flip orbit
@@ -755,6 +783,21 @@ def mix_two_qubit_depol_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     pack-and-exchange, QuEST_cpu_distributed.c:553-852), then one fused
     elementwise combine (see ops/density.mix_two_qubit_depolarising for
     the block formula)."""
+    nloc = 2 * num_qubits - num_shard_bits(mesh)
+    nex = sum(1 for q in (qubit1, qubit2) if q + num_qubits >= nloc)
+    if nex:
+        _record_exchange(amps, "depol2", nex,
+                         nex * _shard_payload_bytes(amps, mesh), 1)
+    return _mix_two_qubit_depol_sharded(
+        amps, prob, mesh=mesh, num_qubits=num_qubits, qubit1=qubit1,
+        qubit2=qubit2)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "num_qubits", "qubit1", "qubit2"),
+         donate_argnums=0)
+def _mix_two_qubit_depol_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
+                                 qubit1: int, qubit2: int):
     nq = num_qubits
     nn = 2 * nq
     ndev = amp_axis_size(mesh)
@@ -904,8 +947,6 @@ def _qft_mesh_layer(local, idx, t: int, base: int, nloc: int, ndev: int,
     return jnp.where(mybit == 1, ph, comb)
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "conj"),
-         donate_argnums=0)
 def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
                       conj: bool = False):
     """Full-register QFT on a SHARDED statevector, one shard_map end to
@@ -927,6 +968,22 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
 
     Collectives: r ppermutes + 1 all_to_all, all riding ICI.
     """
+    r = num_shard_bits(mesh)
+    if r:
+        payload = _shard_payload_bytes(amps, mesh)
+        ndev = amp_axis_size(mesh)
+        # r full-shard H-exchanges + the reversal all_to_all, which moves
+        # every block but the diagonal one: (ndev-1)/ndev of a shard
+        _record_exchange(amps, "qft", r + 1,
+                         r * payload + (payload * (ndev - 1)) // ndev, 1)
+    return _fused_qft_sharded(amps, mesh=mesh, num_qubits=num_qubits,
+                              conj=conj)
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "conj"),
+         donate_argnums=0)
+def _fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
+                       conj: bool = False):
     from ..ops import fused as _fused
 
     n = num_qubits
@@ -1033,8 +1090,39 @@ def _reverse_run_sharded(local, base: int, count: int, nloc: int,
     return local
 
 
-@partial(jax.jit, static_argnames=("mesh", "num_qubits", "runs"),
-         donate_argnums=0)
+def qft_runs_exchange_model(runs, nloc: int, itemsize: int = 8):
+    """(collective count, per-shard ICI bytes) of fused_qft_runs_sharded
+    for ``runs`` — the cost-model companion of circuit.remap_exchange_bytes:
+    per run reaching mesh bits, one full-shard ppermute per mesh-bit
+    layer, one half-shard exchange per mixed reversal pair, and one
+    composed full-shard ppermute when any mesh<->mesh reversal pairs
+    fold (matching _reverse_run_sharded's class folding).  Fully-local
+    runs cost zero."""
+    shard = 2 * (1 << nloc) * itemsize
+    count = 0
+    nbytes = 0
+    for base, cnt, _conj in runs:
+        top = base + cnt
+        layers = max(0, top - max(base, nloc))
+        count += layers
+        nbytes += layers * shard
+        mixed = mesh_pairs = 0
+        for i in range(cnt // 2):
+            p, q = base + i, top - 1 - i
+            if q < nloc:
+                continue
+            if p >= nloc:
+                mesh_pairs += 1
+            else:
+                mixed += 1
+        if mesh_pairs:
+            count += 1
+            nbytes += shard
+        count += mixed
+        nbytes += mixed * (shard // 2)
+    return count, nbytes
+
+
 def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
                            runs: Tuple[Tuple[int, int, bool], ...]):
     """QFT over contiguous qubit runs [(base, count, conj), ...] of a
@@ -1055,6 +1143,18 @@ def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
 
     Collectives for a run with s sharded bits: s ppermutes (layers) +
     at most s reversal ppermutes; fully-local runs cost zero."""
+    nloc = num_qubits - num_shard_bits(mesh)
+    cnt, nbytes = qft_runs_exchange_model(runs, nloc, amps.dtype.itemsize)
+    if cnt:
+        _record_exchange(amps, "qft_runs", cnt, nbytes, 1)
+    return _fused_qft_runs_sharded(amps, mesh=mesh, num_qubits=num_qubits,
+                                   runs=tuple(runs))
+
+
+@partial(jax.jit, static_argnames=("mesh", "num_qubits", "runs"),
+         donate_argnums=0)
+def _fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
+                            runs: Tuple[Tuple[int, int, bool], ...]):
     from .. import circuit as CIRC
 
     n = num_qubits
@@ -1204,6 +1304,19 @@ def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
     if chunks is None:
         nbytes = _shard_payload_bytes(amps, mesh)
         chunks = (exchange_chunks(nbytes // 2), exchange_chunks(nbytes))
+    if _telemetry.enabled() and not isinstance(amps, jax.core.Tracer):
+        from .. import circuit as CIRC
+
+        r = num_shard_bits(mesh)
+        nloc = num_qubits - r
+        mixed, _lp, mesh_tau = decompose_sigma(tuple(sigma), nloc, r)
+        cnt = len(mixed) + (1 if mesh_tau is not None else 0)
+        if cnt:
+            _telemetry.record_exchange(
+                "remap", cnt,
+                CIRC.remap_exchange_bytes(tuple(sigma), num_qubits, nloc,
+                                          amps.dtype.itemsize),
+                chunks=str(chunks))
     return _remap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
                           sigma=tuple(sigma),
                           chunks=(int(chunks[0]), int(chunks[1])))

@@ -48,6 +48,8 @@ module is that layer for quest_tpu:
 from __future__ import annotations
 
 import hashlib
+import json
+import logging
 import os
 import shutil
 import time
@@ -56,7 +58,19 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .validation import QuESTError
+
+# structured run-context logging: every checkpoint/restore/watchdog event
+# in run_resumable emits ONE JSON line through this stdlib logger (no
+# bare prints; operators attach handlers / pytest captures via caplog)
+_RUN_LOG = logging.getLogger("quest_tpu.resilience")
+
+
+def _log_event(run_id: str, event: str, **fields) -> None:
+    payload = {"event": event, "run": run_id}
+    payload.update(fields)
+    _RUN_LOG.info(json.dumps(payload, sort_keys=True))
 
 # ---------------------------------------------------------------------------
 # Degradation registry (graceful-downgrade observability)
@@ -73,6 +87,7 @@ def record_degradation(name: str, reason: str) -> None:
     if name in DEGRADATIONS:
         return
     DEGRADATIONS[name] = reason
+    _telemetry.inc("degradations_total", name=name)
     warnings.warn(f"quest_tpu degraded: {name}: {reason}", stacklevel=2)
 
 
@@ -142,6 +157,7 @@ def retry_io(fn, *args, attempts: Optional[int] = None,
                 return fn(*args, **kwargs)
             except (OSError, TimeoutError) as e:  # includes IOError
                 last = e
+        _telemetry.inc("checkpoint_io_retries_total", what=what)
         if k + 1 < attempts:
             time.sleep(base_delay * (1 << k))
     raise QuESTError(
@@ -349,6 +365,7 @@ def save_generation(qureg, ckpt_dir: str, cursor: int, *,
     from . import rng as _rng
     from .ops import measurement as M
 
+    t0 = time.perf_counter()
     ckpt_dir = os.path.abspath(ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
     gen = os.path.join(ckpt_dir, _gen_name(cursor))
@@ -381,6 +398,9 @@ def save_generation(qureg, ckpt_dir: str, cursor: int, *,
     if faults is not None and faults.should_corrupt(window):
         _corrupt_generation(gen)
     _prune_generations(ckpt_dir, keep=_GENS_KEPT)
+    _telemetry.inc("checkpoints_total")
+    _telemetry.observe("checkpoint_commit_seconds",
+                       time.perf_counter() - t0)
     return gen
 
 
@@ -467,7 +487,9 @@ def load_latest(ckpt_dir: str, env):
     last_err = None
     for cursor in candidates:
         try:
-            return _load_generation(ckpt_dir, cursor, env)
+            loaded = _load_generation(ckpt_dir, cursor, env)
+            _telemetry.inc("checkpoint_restores_total")
+            return loaded
         except QuESTError:
             raise  # structured mismatch (precision/qubits): not corruption
         except Exception as e:  # corrupt payload/metadata: try older gen
@@ -519,6 +541,8 @@ def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
     if faults is None:
         faults = FaultPlan.from_env()
     fp = circuit_fingerprint(glist, qureg.num_qubits_in_state_vec, every)
+    run_id = fp[:12]
+    t_run = time.perf_counter()
 
     start = 0
     loaded = load_latest(ckpt_dir, qureg.env)
@@ -532,6 +556,9 @@ def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
                 f"run's {fp!r}); refusing to resume")
         _restore_into(qureg, restored, meta)
         start = int(meta.get("cursor", 0))
+        _log_event(run_id, "restore", cursor=start,
+                   generation=_gen_name(start), window=start // every,
+                   elapsed=round(time.perf_counter() - t_run, 4))
 
     _ACTIVE_FAULTS[0] = faults
     try:
@@ -549,10 +576,17 @@ def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
                 _fusion.stop_gate_fusion(qureg)  # drain: the window pass
             if faults is not None:
                 faults.maybe_corrupt_amps(qureg, window)
-            _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end))
+            _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end),
+                           log_ctx=(run_id, t_run))
             cursor = end
-            save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
-                            faults=faults, window=window)
+            t_ck = time.perf_counter()
+            with _telemetry.span("resilience.checkpoint", window=window):
+                save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
+                                faults=faults, window=window)
+            _log_event(run_id, "checkpoint", window=window, cursor=cursor,
+                       generation=_gen_name(cursor),
+                       seconds=round(time.perf_counter() - t_ck, 4),
+                       elapsed=round(time.perf_counter() - t_run, 4))
         return qureg
     finally:
         _ACTIVE_FAULTS[0] = None
@@ -581,7 +615,16 @@ def _restore_into(qureg, restored, meta) -> None:
 
 
 def _watchdog_step(qureg, ckpt_dir: str, policy: str,
-                   window: Tuple[int, int]) -> None:
+                   window: Tuple[int, int],
+                   log_ctx: Optional[Tuple[str, float]] = None) -> None:
+    def _verdict(v: str) -> None:
+        _telemetry.inc("watchdog_verdicts_total", policy=policy, verdict=v)
+        if log_ctx is not None:
+            run_id, t_run = log_ctx
+            _log_event(run_id, "watchdog", window=list(window), verdict=v,
+                       norm=round(norm, 9), finite=finite,
+                       elapsed=round(time.perf_counter() - t_run, 4))
+
     norm, finite = check_qureg_health(qureg)
     tol = _health_tolerance(qureg.dtype)
     drift = abs(norm - 1.0)
@@ -589,6 +632,7 @@ def _watchdog_step(qureg, ckpt_dir: str, policy: str,
     # < 1 under noise — only finiteness is checked for them
     norm_bad = (not qureg.is_density_matrix) and drift > tol
     if finite and not norm_bad:
+        _verdict("ok")
         return
     desc = ("non-finite amplitudes" if not finite
             else f"norm drift |{norm:.6g} - 1| > {tol:g}")
@@ -603,19 +647,23 @@ def _watchdog_step(qureg, ckpt_dir: str, policy: str,
         scale = jnp.asarray(1.0 / np.sqrt(norm), amps.dtype)
         qureg._set_amps_permuted(amps * scale, perm)
         warnings.warn(f"run_resumable: {msg}; renormalized", stacklevel=2)
+        _verdict("renormalized")
         return
     if policy == "rollback":
         loaded = load_latest(ckpt_dir, qureg.env)
         if loaded is not None:
             restored, meta = loaded
             _restore_into(qureg, restored, meta)
+            _verdict("rollback")
             raise NumericalHealthError(
                 f"{msg}; rolled back to last-good checkpoint at gate "
                 f"cursor {meta.get('cursor', 0)} — re-run run_resumable "
                 "to resume from it",
                 window=window, norm=norm, finite=finite,
                 rolled_back_to=int(meta.get("cursor", 0)))
+        _verdict("rollback_failed")
         raise NumericalHealthError(
             f"{msg}; no last-good checkpoint exists to roll back to",
             window=window, norm=norm, finite=finite)
+    _verdict("raise")
     raise NumericalHealthError(msg, window=window, norm=norm, finite=finite)

@@ -15,6 +15,8 @@ from typing import Iterator, Optional
 
 import jax
 
+from .. import telemetry as _telemetry
+
 
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
@@ -40,7 +42,10 @@ def annotate(name: str):
 @contextlib.contextmanager
 def timed(label: str, sync: Optional[object] = None) -> Iterator[dict]:
     """Wall-clock a block, blocking on ``sync`` (an array) if given; the
-    yielded dict gains {'seconds': ...} on exit."""
+    yielded dict gains {'seconds': ...} on exit.  The result is also
+    observed into the ``timed_seconds{label}`` telemetry histogram, so
+    ad-hoc timings accumulate in the same registry snapshot/Prometheus
+    export as the built-in instrumentation."""
     out: dict = {"label": label}
     t0 = time.perf_counter()
     try:
@@ -49,3 +54,27 @@ def timed(label: str, sync: Optional[object] = None) -> Iterator[dict]:
         if sync is not None:
             jax.block_until_ready(sync)
         out["seconds"] = time.perf_counter() - t0
+        _telemetry.observe("timed_seconds", out["seconds"], label=label)
+
+
+def memory_watermark() -> dict:
+    """Per-device HBM statistics: ``{device: memory_stats() dict}`` via
+    ``jax.local_devices()[i].memory_stats()``, with a graceful fallback
+    to an empty dict on backends that expose none (CPU returns None).
+    Byte watermarks are also published as telemetry gauges
+    (``device_bytes_in_use`` / ``device_peak_bytes_in_use{device}``)."""
+    out: dict = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent API
+            stats = None
+        stats = dict(stats) if stats else {}
+        out[str(d)] = stats
+        if "bytes_in_use" in stats:
+            _telemetry.set_gauge("device_bytes_in_use",
+                                 stats["bytes_in_use"], device=str(d))
+        if "peak_bytes_in_use" in stats:
+            _telemetry.set_gauge("device_peak_bytes_in_use",
+                                 stats["peak_bytes_in_use"], device=str(d))
+    return out

@@ -1,0 +1,109 @@
+"""Telemetry overhead guard: enabled-mode accounting on a 1k-gate fusion
+drain must cost < 5% over QT_TELEMETRY=off (ISSUE 4 acceptance — the
+off path must also be statistically indistinguishable from pre-PR
+dispatch latency, which this A/B bounds from above: the off path is one
+module-global int test per hook).
+
+The workload is the instrumentation-heaviest shape: 1000 dense gates
+issued through the imperative API inside ONE gateFusion drain (each
+gate call pays a dispatch-family counter, the drain pays the plan-cache
+/ window / span hooks), then a state read.  Identical gate matrices
+every repetition, so the plan cache and compiled-executor cache are
+warm and the measured time is dominated by exactly the host dispatch
+loop telemetry instruments.
+
+Usage: python scripts/bench_telemetry.py [--n 12] [--gates 1000]
+       [--reps 5] [--budget 0.05] [--no-check]
+Exits non-zero when the overhead exceeds the budget (unless --no-check).
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import telemetry  # noqa: E402
+
+
+def _arg(flag, default, cast=int):
+    return cast(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def main():
+    n = _arg("--n", 12)
+    gates = _arg("--gates", 1000)
+    reps = _arg("--reps", 5)
+    budget = _arg("--budget", 0.05, float)
+    env = qt.createQuESTEnv()
+    rng = np.random.default_rng(17)
+    g = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    u, _ = np.linalg.qr(g)
+    cx = np.array([[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0]],
+                  dtype=complex)
+
+    def run():
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            k = 0
+            while k < gates:
+                for t in range(n):
+                    qt.unitary(q, t, u)
+                    k += 1
+                for t in range(n - 1):
+                    qt.twoQubitUnitary(q, t, t + 1, cx)
+                    k += 1
+        return qt.calcTotalProb(q)
+
+    def best_of(mode):
+        telemetry.configure(mode)
+        run()  # warm caches under this mode (plan cache, jit executor)
+        best = math.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # interleave-friendly order: measure off first (the baseline), then
+    # on, then re-check off to catch drift on noisy hosts
+    off_s = best_of("off")
+    on_s = best_of("on")
+    off2_s = best_of("off")
+    telemetry.configure()  # back to the env-var default
+    off_best = min(off_s, off2_s)
+    overhead = on_s / off_best - 1.0
+    rec = {
+        "bench": "telemetry_overhead_1k_gate_drain",
+        "n": n,
+        "gates": gates,
+        "backend": jax.default_backend(),
+        "off_seconds": round(off_best, 5),
+        "on_seconds": round(on_s, 5),
+        "overhead": round(overhead, 4),
+        "budget": budget,
+        "ok": overhead <= budget,
+    }
+    print(json.dumps(rec), flush=True)
+    if "--no-check" in sys.argv:
+        return 0
+    if overhead > budget:
+        print(f"FAIL: telemetry enabled-mode overhead {overhead:.1%} "
+              f"exceeds the {budget:.0%} budget", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

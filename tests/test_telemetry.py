@@ -1,0 +1,499 @@
+"""Unified telemetry layer (quest_tpu/telemetry.py, ISSUE 4).
+
+Covers the acceptance contract:
+  * counter/label semantics (canonical label order, accumulation,
+    per-series isolation) and histogram bucket bookkeeping;
+  * span nesting emits Chrome-trace "X" events with the schema Perfetto
+    loads, and ``write_trace`` round-trips them through JSON;
+  * ``snapshot()`` / ``prometheus_text()`` agree series-for-series;
+  * ``QT_TELEMETRY=off`` yields an empty snapshot, empty exposition,
+    and never creates trace files;
+  * the pinned 8-shard dryrun circuit's exchange count and byte totals
+    match ``circuit.remap_exchange_bytes``'s cost model EXACTLY;
+  * the fusion drain, resilience, and measurement instrumentation all
+    report into the same registry, and ``run_resumable`` logs one JSON
+    line per checkpoint/restore/watchdog event.
+"""
+
+import json
+import logging
+import re
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu import fusion
+from quest_tpu import resilience as R
+from quest_tpu import telemetry as T
+from quest_tpu.parallel import dist
+
+H_SOA = np.stack([(1 / np.sqrt(2)) * np.array([[1.0, 1], [1, -1]]),
+                  np.zeros((2, 2))])
+
+
+@pytest.fixture(autouse=True)
+def tele():
+    """Telemetry on + a clean registry per test; the session mode is
+    restored afterwards so other suites see their configured default."""
+    prev = T.mode_name()
+    T.configure("on")
+    T.reset()
+    yield T
+    T.reset()
+    T.configure(prev)
+
+
+def _sum(series: dict) -> float:
+    return sum(series.values())
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_accumulates(self):
+        T.inc("widgets_total")
+        T.inc("widgets_total", 2)
+        assert T.counter_total("widgets_total") == 3
+
+    def test_labels_are_canonical_and_isolated(self):
+        """Label ORDER never splits a series; label VALUES always do."""
+        T.inc("exchanges_total", 1, op="remap", chunks="4")
+        T.inc("exchanges_total", 2, chunks="4", op="remap")
+        T.inc("exchanges_total", 5, op="swap", chunks="4")
+        snap = T.snapshot()["counters"]["exchanges_total"]
+        assert snap["chunks=4,op=remap"] == 3
+        assert snap["chunks=4,op=swap"] == 5
+        assert T.counter_value("exchanges_total", op="remap", chunks=4) == 3
+
+    def test_non_string_label_values_coerced(self):
+        T.inc("c_total", 1, chunks=8)
+        assert T.counter_value("c_total", chunks="8") == 1
+
+    def test_gauge_overwrites(self):
+        T.set_gauge("g", 1.0, device="d0")
+        T.set_gauge("g", 7.5, device="d0")
+        assert T.snapshot()["gauges"]["g"]["device=d0"] == 7.5
+
+    def test_histogram_stats_and_buckets(self):
+        for v in (0.0005, 0.05, 0.05, 3.0):
+            T.observe("lat_seconds", v)
+        h = T.snapshot()["histograms"]["lat_seconds"][""]
+        assert h["count"] == 4
+        assert h["min"] == 0.0005 and h["max"] == 3.0
+        assert abs(h["sum"] - 3.1005) < 1e-12
+        # cumulative le-buckets are monotone and end at the total count
+        cums = list(h["buckets"].values())
+        assert cums == sorted(cums) and cums[-1] == 4
+        assert h["buckets"]["0.001"] == 1      # 0.0005
+        assert h["buckets"]["0.1"] == 3        # + the two 0.05s
+
+    def test_snapshot_folds_legacy_registries(self):
+        """env._CACHE_STATS and the degradation registry surface as
+        series of the same namespace (satellite: one consolidated view,
+        old accessors keep working)."""
+        snap = T.snapshot()
+        assert "compile_cache_hits_total" in snap["counters"]
+        assert "compile_cache_misses_total" in snap["counters"]
+        from quest_tpu import env as E
+
+        assert set(E.compile_cache_stats()) == {"hits", "misses", "dir"}
+
+    def test_degradation_becomes_series(self, monkeypatch):
+        monkeypatch.setattr(R, "DEGRADATIONS", {}, raising=True)
+        with pytest.warns(UserWarning):
+            R.record_degradation("unit_test", "synthetic downgrade")
+        snap = T.snapshot()
+        assert snap["counters"]["degradations_total"]["name=unit_test"] == 1
+        assert snap["gauges"]["degradation_active"]["name=unit_test"] == 1.0
+        assert R.degradation_report() == {"unit_test": "synthetic downgrade"}
+
+
+# ---------------------------------------------------------------------------
+# Off mode
+# ---------------------------------------------------------------------------
+
+
+class TestOffMode:
+    def test_off_yields_empty_everything(self):
+        T.inc("pre_total")
+        T.configure("off")
+        T.inc("post_total")
+        assert T.snapshot() == {}
+        assert T.prometheus_text() == ""
+        assert T.counter_total("post_total") == 0
+        # recording resumes (and the pre-off series survives) on re-enable
+        T.configure("on")
+        assert T.counter_total("pre_total") == 1
+        assert T.counter_total("post_total") == 0
+
+    def test_off_no_trace_files(self, tmp_path):
+        T.configure("off")
+        with T.span("invisible"):
+            pass
+        out = T.write_trace(str(tmp_path / "t.json"))
+        assert out is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_env_var_resolution(self, monkeypatch):
+        monkeypatch.setenv("QT_TELEMETRY", "off")
+        assert T.configure() == "off"
+        monkeypatch.setenv("QT_TELEMETRY", "trace")
+        assert T.configure() == "trace"
+        monkeypatch.delenv("QT_TELEMETRY")
+        assert T.configure() == "on"  # the always-on default
+
+    def test_off_dispatch_is_silent(self, env):
+        T.configure("off")
+        q = qt.createQureg(3, env)
+        qt.hadamard(q, 0)
+        qt.measure(q, 0)
+        assert T.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Spans and Chrome trace
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_span_records_duration_histogram(self):
+        with T.span("unit.work"):
+            pass
+        h = T.snapshot()["histograms"]["span_seconds"]["name=unit.work"]
+        assert h["count"] == 1 and h["sum"] >= 0
+
+    def test_nested_spans_chrome_schema(self, tmp_path):
+        T.configure("trace")
+        with T.span("outer", phase="drain"):
+            with T.span("inner"):
+                pass
+        path = T.write_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        assert [e["name"] for e in events] == ["inner", "outer"]
+        for e in events:
+            assert e["ph"] == "X" and e["cat"] == "quest_tpu"
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        inner, outer = events
+        # proper nesting: inner starts after outer and ends before it
+        assert inner["tid"] == outer["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+        assert outer["args"] == {"phase": "drain"}
+
+    def test_write_trace_drains_buffer(self, tmp_path):
+        T.configure("trace")
+        with T.span("once"):
+            pass
+        assert T.write_trace(str(tmp_path / "a.json")) is not None
+        assert T.write_trace(str(tmp_path / "b.json")) is None
+        assert not (tmp_path / "b.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+_PROM_LINE = re.compile(r"^(\w+)(?:\{(.*)\})? ([-+0-9.e]+)$")
+
+
+def _parse_prom(text: str) -> dict:
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, value = m.groups()
+        labels = ",".join(
+            part.replace('"', "") for part in (labels or "").split(","))
+        out[(name, labels)] = float(value)
+    return out
+
+
+class TestPrometheus:
+    def test_round_trip_matches_snapshot(self):
+        T.inc("exchanges_total", 3, op="remap", chunks="2")
+        T.inc("exchanges_total", 1, op="swap", chunks="1")
+        T.set_gauge("hbm_bytes", 123.0, device="cpu0")
+        T.observe("lat_seconds", 0.02)
+        parsed = _parse_prom(T.prometheus_text())
+        snap = T.snapshot()
+        for name, series in snap["counters"].items():
+            for labels, v in series.items():
+                assert parsed[(name, labels)] == pytest.approx(v)
+        for name, series in snap["gauges"].items():
+            for labels, v in series.items():
+                assert parsed[(name, labels)] == pytest.approx(v)
+        # histogram triplet: _count/_sum/_bucket with cumulative le
+        assert parsed[("lat_seconds_count", "")] == 1
+        assert parsed[("lat_seconds_sum", "")] == pytest.approx(0.02)
+        assert parsed[("lat_seconds_bucket", "le=+Inf")] == 1
+
+    def test_type_lines_present(self):
+        T.inc("a_total")
+        T.set_gauge("b", 1)
+        T.observe("c_seconds", 0.5)
+        text = T.prometheus_text()
+        assert "# TYPE a_total counter" in text
+        assert "# TYPE b gauge" in text
+        assert "# TYPE c_seconds histogram" in text
+
+
+# ---------------------------------------------------------------------------
+# The 8-shard dryrun: exchange accounting vs the cost model
+# ---------------------------------------------------------------------------
+
+
+def _expected_remap_cost(bit_sets, n, nloc, r, itemsize):
+    """Re-derive what the drain + final canonical read must exchange,
+    straight from the scheduling layer's own cost model."""
+    count = 0
+    nbytes = 0
+    segments, final_perm = CIRC.plan_remap_windows(bit_sets, n, nloc, None)
+    sigmas = [s for _ij, s, _p in segments if s is not None]
+    if final_perm is not None and list(final_perm) != list(range(n)):
+        sigmas.append(dist.canonical_sigma(final_perm))
+    for sigma in sigmas:
+        mixed, _lp, mesh_tau = dist.decompose_sigma(sigma, nloc, r)
+        count += len(mixed) + (1 if mesh_tau is not None else 0)
+        nbytes += CIRC.remap_exchange_bytes(sigma, n, nloc, itemsize)
+    return count, nbytes
+
+
+class TestExchangeAccounting:
+    @pytest.fixture(autouse=True)
+    def _mesh(self, env):
+        if env.num_devices < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        dist.use_explicit_dist(True)
+        dist.use_lazy_remap(True)
+        yield
+
+    def test_pinned_dryrun_matches_remap_cost_model(self, env):
+        """Acceptance: the pinned 8-shard circuit's telemetry exchange
+        count and byte totals equal circuit.remap_exchange_bytes's model
+        EXACTLY — one windowed remap inside the drain plus the canonical
+        rematerialization on the final read, nothing else."""
+        n, r = 6, dist.num_shard_bits(env.mesh)
+        nloc = n - r
+        rng = np.random.default_rng(3)
+        g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+        u, _ = np.linalg.qr(g)
+        q = qt.createQureg(n, env)
+        itemsize = np.dtype(q.dtype).itemsize
+        bit_sets = [(0, 1), (n - 2, n - 1), (0, 1)]
+        exp_count, exp_bytes = _expected_remap_cost(
+            bit_sets, n, nloc, r, itemsize)
+        assert exp_count > 0 and exp_bytes > 0  # the circuit IS sharded
+        T.reset()
+        with qt.gateFusion(q):
+            for a, b in bit_sets:
+                qt.multiQubitUnitary(q, [a, b], u)
+        _ = qt.calcProbOfOutcome(q, 0, 0)  # drains + rematerializes
+        snap = T.snapshot()
+        got_bytes = _sum(snap["counters"]["exchange_bytes_total"])
+        got_count = _sum(snap["counters"]["exchanges_total"])
+        assert got_bytes == exp_bytes
+        assert got_count == exp_count
+        # and both op families are present: the in-drain window remap
+        # and the canonical-order rematerialization on read
+        assert "op=window_remap" in snap["counters"]["exchange_bytes_total"]
+        assert "op=remap" in snap["counters"]["exchange_bytes_total"]
+
+    def test_eager_1q_exchange_payload(self, env):
+        """A sharded-target 1q gate records one full-shard exchange with
+        the resolved chunk config."""
+        n = 6
+        amps = qt.createQureg(n, env).amps
+        T.reset()
+        out = dist.apply_matrix_1q_sharded(
+            amps, H_SOA.reshape(2, 2, 2), mesh=env.mesh, num_qubits=n,
+            target=n - 1, chunks=2)
+        out.block_until_ready()
+        shard_bytes = 2 * (1 << (n - dist.num_shard_bits(env.mesh))) \
+            * amps.dtype.itemsize
+        assert T.counter_value("exchanges_total",
+                               op="matrix_1q", chunks=2) == 1
+        assert T.counter_value("exchange_bytes_total",
+                               op="matrix_1q") == shard_bytes
+
+    def test_swap_records_half_shard(self, env):
+        n = 6
+        amps = qt.createQureg(n, env).amps
+        T.reset()
+        dist.swap_sharded(amps, mesh=env.mesh, num_qubits=n,
+                          qb_low=0, qb_high=n - 1).block_until_ready()
+        shard_bytes = 2 * (1 << (n - dist.num_shard_bits(env.mesh))) \
+            * amps.dtype.itemsize
+        assert T.counter_value("exchange_bytes_total",
+                               op="swap") == shard_bytes // 2
+
+    def test_no_double_count_inside_user_jit(self, env):
+        """A wrapper reached while TRACING a user jit must not record —
+        dispatch-time accounting, not trace-time."""
+        import jax
+
+        n = 6
+        amps = qt.createQureg(n, env).amps
+        jfn = jax.jit(lambda a: dist.swap_sharded(
+            a, mesh=env.mesh, num_qubits=n, qb_low=0, qb_high=n - 1))
+        T.reset()
+        jfn(amps).block_until_ready()
+        jfn(amps).block_until_ready()
+        assert T.counter_total("exchanges_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# Fusion, dispatch, measurement instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestHotLayerHooks:
+    def test_drain_and_plan_cache_counters(self, env):
+        n = 5
+        rng = np.random.default_rng(11)
+        g = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+        u, _ = np.linalg.qr(g)
+
+        def run_once():
+            q = qt.createQureg(n, env)
+            with qt.gateFusion(q):
+                for t in range(n):
+                    qt.unitary(q, t, u)
+            return qt.calcTotalProb(q)
+
+        run_once()  # not measured: may hit stale session-wide caches
+        before = T.snapshot()
+        run_once()
+        after = T.snapshot()
+
+        def delta(name):
+            return (_sum(after["counters"].get(name, {}))
+                    - _sum(before["counters"].get(name, {})))
+
+        assert delta("fusion_drains_total") == 1
+        assert delta("fusion_plan_cache_hits_total") == 1
+        assert delta("fusion_plan_cache_misses_total") == 0
+        assert delta("fusion_retrace_total") == 0  # same program shape
+        assert delta("fusion_windows_total") >= 1
+        assert after["counters"]["dispatch_total"]["family=unitary"] \
+            >= before["counters"]["dispatch_total"]["family=unitary"] + n
+        h = after["histograms"]["fusion_drain_gates"][""]
+        assert h["count"] >= 2 and h["max"] >= n
+
+    def test_measurement_shot_counters(self, env):
+        q = qt.createQureg(3, env)
+        qt.hadamard(q, 0)
+        T.reset()
+        qt.measure(q, 0)
+        qt.measureSequence(q, [0, 1, 2])
+        assert T.counter_total("measurement_shots_total") == 4
+
+    def test_environment_string_has_consolidated_block(self, env):
+        qt.hadamard(qt.createQureg(2, env), 0)
+        s = qt.getEnvironmentString(env)
+        assert "[telemetry: on" in s
+        assert "dispatch=" in s
+        T.configure("off")
+        assert "[telemetry: off]" in qt.getEnvironmentString(env)
+
+    def test_report_perf_prints_counters(self, env, capsys):
+        qt.hadamard(qt.createQureg(2, env), 0)
+        qt.reportPerf(env)
+        out = capsys.readouterr().out
+        assert "quest_tpu perf report" in out
+        assert "dispatch_total{family=unitary}" in out
+        assert "EnvType=quest_tpu" in out
+
+
+# ---------------------------------------------------------------------------
+# Profiling satellites
+# ---------------------------------------------------------------------------
+
+
+class TestProfilingHooks:
+    def test_timed_observes_histogram(self):
+        from quest_tpu.utils import profiling
+
+        with profiling.timed("unit_block") as t:
+            pass
+        assert "seconds" in t
+        h = T.snapshot()["histograms"]["timed_seconds"]["label=unit_block"]
+        assert h["count"] == 1
+        assert abs(h["sum"] - t["seconds"]) < 1e-9
+
+    def test_memory_watermark_per_device(self):
+        import jax
+
+        from quest_tpu.utils import profiling
+
+        wm = profiling.memory_watermark()
+        assert len(wm) == len(jax.local_devices())
+        # CPU backend exposes no stats: the graceful fallback is {}
+        for stats in wm.values():
+            assert isinstance(stats, dict)
+
+
+# ---------------------------------------------------------------------------
+# Resilience instrumentation + structured run logging
+# ---------------------------------------------------------------------------
+
+
+class TestResilienceHooks:
+    def test_checkpoint_metrics_and_json_log(self, env, tmp_path, caplog):
+        n, every = 4, 2
+        gates = [CIRC.Gate((t,), H_SOA) for t in range(n)]
+        q = qt.createQureg(n, env)
+        T.reset()
+        with caplog.at_level(logging.INFO, logger="quest_tpu.resilience"):
+            qt.run_resumable(q, gates, str(tmp_path / "ck"), every=every)
+        snap = T.snapshot()
+        assert _sum(snap["counters"]["checkpoints_total"]) == 2
+        assert snap["histograms"]["checkpoint_commit_seconds"][""]["count"] \
+            == 2
+        verdicts = snap["counters"]["watchdog_verdicts_total"]
+        assert verdicts["policy=raise,verdict=ok"] == 2
+        # one JSON line per event, each carrying the run context
+        events = [json.loads(rec.message) for rec in caplog.records]
+        kinds = [e["event"] for e in events]
+        assert kinds.count("checkpoint") == 2
+        assert kinds.count("watchdog") == 2
+        run_ids = {e["run"] for e in events}
+        assert len(run_ids) == 1
+        for e in events:
+            assert "elapsed" in e
+            if e["event"] == "checkpoint":
+                assert e["generation"].startswith("gen-")
+                assert "window" in e and "seconds" in e
+
+    def test_restore_logs_and_counts(self, env, tmp_path, caplog):
+        n, every = 4, 2
+        gates = [CIRC.Gate((t,), H_SOA) for t in range(n)]
+        ck = str(tmp_path / "ck")
+        qt.run_resumable(qt.createQureg(n, env), gates, ck, every=every)
+        T.reset()
+        q2 = qt.createQureg(n, env)
+        with caplog.at_level(logging.INFO, logger="quest_tpu.resilience"):
+            qt.run_resumable(q2, gates, ck, every=every)
+        assert T.counter_total("checkpoint_restores_total") == 1
+        events = [json.loads(rec.message) for rec in caplog.records]
+        assert events[0]["event"] == "restore"
+        assert events[0]["cursor"] == n  # resumed at the finished cursor
+
+    def test_io_retry_counter(self, env, tmp_path):
+        q = qt.createQureg(4, env)
+        plan = qt.FaultPlan("io@2")
+        T.reset()
+        qt.run_resumable(q, [CIRC.Gate((0,), H_SOA)],
+                         str(tmp_path / "ck"), every=1, faults=plan)
+        assert T.counter_total("checkpoint_io_retries_total") == 2
+        assert plan.log == ["io", "io"]
