@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults verify-telemetry bench docs clean
+.PHONY: all native test verify verify-faults verify-telemetry verify-elastic bench docs clean
 
 all: native
 
@@ -33,6 +33,14 @@ verify:
 # NaN injection + watchdog policies (quest_tpu/resilience.py).
 verify-faults:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Elastic recovery (docs/design.md §19): mesh-portable checkpoints
+# (8->4/8->1/8->16 bit-identical resume), guarded collectives, and
+# degraded-mesh failover — plus the MTTR benchmark with its
+# detect/rollback/reshard/resume phase breakdown.
+verify-elastic:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py tests/test_resilience.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	python scripts/bench_failover.py
 
 # Telemetry layer (quest_tpu/telemetry.py): the unit/integration suite
 # plus the micro-benchmark guard — enabled-mode accounting must cost

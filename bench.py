@@ -404,7 +404,7 @@ def main():
     best = c2.get("kdiff", {}).get("min")   # "seconds" stays the min;
     value = c2.get("amp_updates_per_sec")   # the rate uses the median
     baseline_shape = (N == 26 and DEPTH == 20) and value is not None
-    print(json.dumps({
+    summary = {
         "metric": f"{N}q depth-{DEPTH} random-circuit gate-apply rate",
         "value": value,
         "unit": "amp_updates_per_sec",
@@ -413,16 +413,28 @@ def main():
         "seconds": best,
         "seconds_median": c2.get("kdiff", {}).get("median"),
         "seconds_spread": c2.get("kdiff", {}).get("spread"),
-        "timing": ("config-2 headline: paired K=2 diffs (T[2x]-T[1x] per "
-                   "rep, 7 reps) — device-time marginal; other configs "
-                   "large-K contrast (T[Kx]-best T[1x])/(K-1), K in "
-                   "{4,8,16}; removes fixed relay fetch overhead, bounds "
-                   "drift; sustained dispatch-bound rate reported "
-                   "separately"),
         "backend": jax.default_backend(),
         "total_bench_s": round(time.time() - t_start, 1),
-        "configs": configs,
-    }))
+    }
+    # full per-config results go to a FILE: the one-line-of-everything
+    # stdout artifact outgrew tail capture and truncated to parsed:null
+    # (VERDICT r5).  stdout keeps a short headline any capture window
+    # holds; the file carries the timing-methodology note and configs.
+    out_path = os.environ.get("QT_BENCH_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_{time.strftime('%Y%m%d_%H%M%S')}.json")
+    full = dict(summary)
+    full["timing"] = (
+        "config-2 headline: paired K=2 diffs (T[2x]-T[1x] per rep, 7 "
+        "reps) — device-time marginal; other configs large-K contrast "
+        "(T[Kx]-best T[1x])/(K-1), K in {4,8,16}; removes fixed relay "
+        "fetch overhead, bounds drift; sustained dispatch-bound rate "
+        "reported separately")
+    full["configs"] = configs
+    with open(out_path, "w") as f:
+        json.dump(full, f, indent=1)
+    summary["results_file"] = out_path
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

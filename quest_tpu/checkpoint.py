@@ -43,6 +43,7 @@ def _qureg_meta(qureg: Qureg) -> dict:
         "is_density_matrix": qureg.is_density_matrix,
         "dtype": str(np.dtype(qureg.dtype)),
         "precision": precision.get_precision(),
+        "mesh_shards": qureg.num_chunks,
     }
 
 
@@ -133,20 +134,54 @@ def saveQureg(qureg: Qureg, path: str) -> None:
                         what="saveQureg(meta)")
 
 
-def loadQureg(path: str, env: QuESTEnv) -> Qureg:
+def loadQureg(path: str, env: QuESTEnv, *, strict_mesh: bool = False) -> Qureg:
     """Restore a register saved by :func:`saveQureg` onto ``env``'s mesh.
 
     The amplitude array is restored directly into the register's current
     sharding (resharding on the fly if the mesh shape changed).  The
     checkpoint metadata is validated against ``env`` FIRST: a precision
-    mismatch (e.g. written at prec 2, loaded at prec 1) or a mesh grown
-    past the register's shardable size raises a QuESTError naming both
-    sides instead of failing inside orbax resharding."""
+    mismatch (e.g. written at prec 2, loaded at prec 1) raises a
+    QuESTError naming both sides instead of failing inside orbax
+    resharding.
+
+    When the mesh has grown past the register's shardable size (more
+    devices than amplitudes), the default is ELASTIC: the environment
+    auto-shrinks to the largest usable device subset (env.shrink_env,
+    recorded in the degradation registry) and the register loads onto
+    that degraded mesh — its ``env`` attribute names the shrunken
+    environment.  ``strict_mesh=True`` restores the old structured
+    error, and additionally refuses ANY shard-count difference from the
+    writing mesh (recorded in the checkpoint metadata)."""
+    from . import resilience, telemetry
+
     path = os.path.abspath(path)
     try:
         meta = _read_meta(path)
     except FileNotFoundError:
         raise QuESTError(f"no qureg checkpoint at {path}", "loadQureg")
+    saved_shards = meta.get("mesh_shards")
+    if strict_mesh and saved_shards is not None \
+            and int(saved_shards) != env.num_devices:
+        raise QuESTError(
+            "loadQureg: checkpoint mesh mismatch — written on "
+            f"{saved_shards} shards but this environment has "
+            f"{env.num_devices} devices, and strict_mesh=True refuses "
+            "elastic restore")
+    n_sv = (2 if meta.get("is_density_matrix") else 1) \
+        * int(meta["num_qubits_represented"])
+    total = 1 << n_sv
+    if not strict_mesh and total < env.num_devices:
+        from . import env as _env_mod
+
+        shrunk = _env_mod.shrink_env(env, total)
+        resilience.record_degradation(
+            f"loadQureg_mesh_{env.num_devices}to{total}",
+            f"the mesh ({env.num_devices} devices) has grown past the "
+            f"register's shardable size ({total} amplitudes); loaded "
+            f"onto a {total}-device sub-mesh")
+        env = shrunk
+    if saved_shards is not None and int(saved_shards) != env.num_devices:
+        telemetry.inc("elastic_restores_total")
     q = _qureg_from_meta(meta, env)
     q.amps = _restore_amps(path, q)
     return q

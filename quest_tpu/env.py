@@ -224,6 +224,32 @@ def create_quest_env(
     return env
 
 
+def shrink_env(env: QuESTEnv, num_devices: int, *,
+               exclude_index: Optional[int] = None) -> QuESTEnv:
+    """A degraded environment over a power-of-two subset of ``env``'s
+    devices — the mesh half of the elastic failover path
+    (resilience._failover) and of loadQureg's auto-reshard.
+
+    ``exclude_index`` drops one device (the presumed-dead shard) before
+    truncating; the result keeps ``env``'s seeds WITHOUT reseeding — the
+    RNG streams belong to the run, not the mesh, and a failover restores
+    them from the checkpoint anyway."""
+    devs = [d for i, d in enumerate(env.mesh.devices.reshape(-1).tolist())
+            if i != exclude_index]
+    num_devices = int(num_devices)
+    if num_devices < 1 or num_devices & (num_devices - 1):
+        raise ValueError(
+            f"shrink_env: num_devices must be a positive power of two, "
+            f"got {num_devices}")
+    if num_devices > len(devs):
+        raise ValueError(
+            f"shrink_env: asked for {num_devices} devices but only "
+            f"{len(devs)} survive in this environment")
+    mesh = Mesh(np.array(devs[:num_devices]), (AMP_AXIS,))
+    return QuESTEnv(mesh=mesh, rank=env.rank, num_ranks=num_devices,
+                    seeds=env.seeds)
+
+
 def destroy_quest_env(env: QuESTEnv) -> None:
     """destroyQuESTEnv (QuEST.h:1864) — nothing to free; arrays are GC'd."""
 
@@ -273,6 +299,15 @@ def get_environment_string(env: QuESTEnv) -> str:
     # namespace; the legacy fields stay for compatibility)
     from . import telemetry
 
+    # elastic-recovery surface: completed failovers and guarded-collective
+    # timeouts, pulled from the registry so operators see degraded-mesh
+    # history without parsing the telemetry block
+    failovers = telemetry.counter_total("failovers_total")
+    if failovers:
+        s += f" Failovers={int(failovers)}"
+    timeouts = telemetry.counter_total("exchange_timeouts_total")
+    if timeouts:
+        s += f" ExchangeTimeouts={int(timeouts)}"
     s += f" [telemetry: {telemetry.summary()}]"
     return s
 

@@ -291,7 +291,15 @@ def _run(qureg, items) -> None:
     # permutation the windowed plan leaves behind is carried on the
     # register — the next drain starts from it, the next READ
     # rematerializes canonical order (Qureg.amps)
-    qureg._amps = runner(qureg._amps, arrays, probs)
+    if nsh:
+        # sharded drains carry the window's exchanges: dispatch under the
+        # collective guard so a dead peer surfaces as ShardLossError and
+        # the resilience layer can fail over (docs/design.md §19)
+        qureg._amps = PAR.guarded_dispatch(
+            runner, qureg._amps, arrays, probs,
+            op="drain", shards=qureg.num_chunks)
+    else:
+        qureg._amps = runner(qureg._amps, arrays, probs)
     if nsh:
         if final_perm is not None and list(final_perm) != list(range(n)):
             qureg._perm = tuple(final_perm)

@@ -67,6 +67,118 @@ def _record_exchange(amps, op: str, count: int, nbytes: int, chunks) -> None:
     _telemetry.record_exchange(op, count, nbytes, chunks=str(chunks))
 
 
+# ---------------------------------------------------------------------------
+# Guarded collectives (elastic recovery, docs/design.md §19)
+#
+# On a healthy mesh an exchange dispatch either completes or raises; on a
+# degraded pod it can also hang (a peer stopped answering) or fail with a
+# runtime error long after the circuit started.  Every sharded dispatch
+# below goes through guarded_dispatch: bounded attempts with exponential
+# backoff (retry_io's policy, shared knobs), dispatch latency observed
+# into the exchange_latency_seconds histogram, a post-hoc deadline that
+# counts exchange_timeouts_total when a dispatch came back slower than
+# QT_EXCHANGE_DEADLINE_S, and — when the retry budget is exhausted — a
+# ShardLossError that the resilience layer's failover loop converts into
+# rollback + mesh shrink (resilience.run_resumable).  Deterministic
+# fault injection enters through EXCHANGE_FAULT_HOOK, armed per window
+# by resilience.FaultPlan (`stall` / `shard_loss` modes).
+# ---------------------------------------------------------------------------
+
+_DEADLINE_ENV = "QT_EXCHANGE_DEADLINE_S"
+_GUARD_ATTEMPTS_ENV = "QT_EXCHANGE_RETRIES"
+
+# fault-injection slot: resilience.run_resumable installs the active
+# FaultPlan's take_exchange_fault here (a plain module slot rather than
+# an import so dist <-> resilience stays acyclic).  The hook takes the
+# op name and returns None, "stall", or "shard_loss".
+EXCHANGE_FAULT_HOOK: list = [None]
+
+
+class ShardLossError(RuntimeError):
+    """A shard is presumed dead: an exchange dispatch kept failing past
+    its retry budget, or the fault plan declared the loss outright.
+    Deliberately NOT a QuESTError — it signals infrastructure failure,
+    not API misuse — so the resilience layer can catch it for failover
+    without masking validation bugs."""
+
+    def __init__(self, msg: str, *, shard: Optional[int] = None,
+                 op: str = "exchange"):
+        super().__init__(msg)
+        self.shard = shard
+        self.op = op
+
+
+def exchange_deadline() -> Optional[float]:
+    """The live per-dispatch deadline in seconds (None = no deadline)."""
+    raw = os.environ.get(_DEADLINE_ENV)
+    if not raw:
+        return None
+    try:
+        d = float(raw)
+    except ValueError:
+        return None
+    return d if d > 0 else None
+
+
+def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
+                     **kwargs):
+    """Run one exchange dispatch under the collective guard.
+
+    Passthrough for traced operands (a dispatch reached from inside a
+    user jit can neither be timed nor retried — it is a trace).  For
+    concrete operands: up to QT_EXCHANGE_RETRIES attempts (default 3)
+    with retry_io-style exponential backoff (QT_RETRY_BASE_SECONDS base);
+    each attempt first consumes one injected fault from
+    EXCHANGE_FAULT_HOOK — ``stall`` burns the attempt as a timed-out
+    dispatch (exchange_timeouts_total), ``shard_loss`` raises
+    ShardLossError immediately — then dispatches, observing the host
+    dispatch latency into exchange_latency_seconds{op,shards} and
+    counting a timeout when it exceeded QT_EXCHANGE_DEADLINE_S (the
+    result is still used: a late synchronous dispatch has already
+    completed — the deadline is SLO accounting, not cancellation).  A
+    real dispatch exception is retried; note most inner programs donate
+    their operand, so a retry after a partially-executed dispatch may
+    surface a deleted-buffer error — the guard converts either into
+    ShardLossError after the budget."""
+    import time as _time
+
+    if args and isinstance(args[0], jax.core.Tracer):
+        return fn(*args, **kwargs)
+    attempts = max(1, int(os.environ.get(_GUARD_ATTEMPTS_ENV, "3")))
+    base_delay = float(os.environ.get("QT_RETRY_BASE_SECONDS", "0.05"))
+    deadline = exchange_deadline()
+    shards = str(shards)
+    last = None
+    for k in range(attempts):
+        hook = EXCHANGE_FAULT_HOOK[0]
+        fault = hook(op) if hook is not None else None
+        if fault == "shard_loss":
+            _telemetry.inc("exchange_timeouts_total", op=op)
+            raise ShardLossError(
+                f"injected shard loss during {op} dispatch", op=op)
+        if fault == "stall":
+            _telemetry.inc("exchange_timeouts_total", op=op)
+            last = TimeoutError(f"injected stall during {op} dispatch")
+        else:
+            t0 = _time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            except Exception as e:  # runtime dispatch failure: retry
+                last = e
+            else:
+                elapsed = _time.perf_counter() - t0
+                _telemetry.observe("exchange_latency_seconds", elapsed,
+                                   op=op, shards=shards)
+                if deadline is not None and elapsed > deadline:
+                    _telemetry.inc("exchange_timeouts_total", op=op)
+                return out
+        if k + 1 < attempts:
+            _time.sleep(base_delay * (1 << k))
+    raise ShardLossError(
+        f"{op} dispatch failed after {attempts} attempts "
+        f"(last error: {last!r})", op=op) from last
+
+
 def use_explicit_dist(enabled: bool) -> None:
     """Toggle the explicit ppermute path vs GSPMD propagation."""
     _CONFIG["explicit"] = bool(enabled)
@@ -317,8 +429,10 @@ def apply_matrix_1q_sharded(
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
     _record_exchange(amps, "matrix_1q", 1, _shard_payload_bytes(amps, mesh),
                      chunks)
-    return _apply_matrix_1q_sharded(
-        amps, matrix, mesh=mesh, num_qubits=num_qubits, target=target,
+    return guarded_dispatch(
+        _apply_matrix_1q_sharded, amps, matrix,
+        op="matrix_1q", shards=amp_axis_size(mesh),
+        mesh=mesh, num_qubits=num_qubits, target=target,
         controls=tuple(controls), control_states=tuple(control_states),
         chunks=int(chunks))
 
@@ -415,8 +529,10 @@ def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh) // 2)
     _record_exchange(amps, "swap", 1, _shard_payload_bytes(amps, mesh) // 2,
                      chunks)
-    return _swap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
-                         qb_low=qb_low, qb_high=qb_high, chunks=int(chunks))
+    return guarded_dispatch(
+        _swap_sharded, amps, op="swap", shards=amp_axis_size(mesh),
+        mesh=mesh, num_qubits=num_qubits,
+        qb_low=qb_low, qb_high=qb_high, chunks=int(chunks))
 
 
 @partial(jax.jit,
@@ -459,7 +575,8 @@ def gather_replicated(amps, *, mesh: Mesh):
     ndev = amp_axis_size(mesh)
     _record_exchange(amps, "gather", 1,
                      _shard_payload_bytes(amps, mesh) * (ndev - 1), 1)
-    return _gather_replicated(amps, mesh=mesh)
+    return guarded_dispatch(_gather_replicated, amps, op="gather",
+                            shards=ndev, mesh=mesh)
 
 
 @partial(jax.jit, static_argnames=("mesh",))
@@ -511,8 +628,10 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
     _record_exchange(amps, "pair_channel", 1,
                      _shard_payload_bytes(amps, mesh), chunks)
-    return _mix_pair_channel_sharded(
-        amps, prob, mesh=mesh, num_qubits=num_qubits, target=target,
+    return guarded_dispatch(
+        _mix_pair_channel_sharded, amps, prob,
+        op="pair_channel", shards=amp_axis_size(mesh),
+        mesh=mesh, num_qubits=num_qubits, target=target,
         kind=kind, chunks=int(chunks))
 
 
@@ -1317,9 +1436,10 @@ def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
                 CIRC.remap_exchange_bytes(tuple(sigma), num_qubits, nloc,
                                           amps.dtype.itemsize),
                 chunks=str(chunks))
-    return _remap_sharded(amps, mesh=mesh, num_qubits=num_qubits,
-                          sigma=tuple(sigma),
-                          chunks=(int(chunks[0]), int(chunks[1])))
+    return guarded_dispatch(
+        _remap_sharded, amps, op="remap", shards=amp_axis_size(mesh),
+        mesh=mesh, num_qubits=num_qubits, sigma=tuple(sigma),
+        chunks=(int(chunks[0]), int(chunks[1])))
 
 
 @partial(jax.jit, static_argnames=("mesh", "num_qubits", "sigma", "chunks"),

@@ -151,6 +151,23 @@ class Qureg:
         self.dtype = np.dtype(dtype)
         self._set_amps_permuted(amps, perm)
 
+    def reshard_to(self, env: QuESTEnv) -> None:
+        """Move this register onto ``env``'s mesh in place, carrying any
+        live logical->physical permutation over unchanged (the perm is a
+        bit permutation of the GLOBAL amplitude index — mesh-shape-
+        independent; see resilience._validated_perm).  Pending fused
+        gates drain on the OLD mesh first so operation order is
+        preserved; subsequent windows plan against the new mesh's shard
+        split (fusion keys its plans on nloc, so nothing stale
+        survives).  This is the live-state half of elastic recovery —
+        checkpointed restores instead reshard on read
+        (resilience.load_latest)."""
+        amps = self._amps_raw()  # drain pending gates on the old mesh
+        perm = self._perm
+        self.env = env
+        self._amps = jax.device_put(amps, self.sharding())
+        self._perm = perm
+
     def _phys_bits(self, bits) -> tuple:
         """Physical positions of logical state-vector bits under the live
         permutation (identity when none is active)."""

@@ -189,16 +189,32 @@ class FaultPlan:
     - ``inf@W``       same with +Inf
     - ``scale@W``     multiply the amplitudes by 1.01 after window W
                       (norm drift for the ``renormalize`` policy)
+    - ``stall@W``     window W's first exchange dispatch stalls past its
+                      deadline once — absorbed by the collective guard's
+                      retry budget (dist.guarded_dispatch), observable as
+                      exchange_timeouts_total
+    - ``shard_loss@W`` a shard dies during window W's exchange dispatch:
+                      the guard raises dist.ShardLossError and
+                      run_resumable fails over (rollback + mesh shrink)
 
     Every fired event is appended to :attr:`log` so tests can assert the
     plan actually executed."""
 
-    _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale")
+    _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale",
+              "stall", "shard_loss")
 
     def __init__(self, spec: str = ""):
         self.events: List[Tuple[str, int]] = []
         self.io_budget = 0
         self.log: List[str] = []
+        # exchange faults pending for the CURRENT window, armed by
+        # run_resumable (arm_exchange_window) and consumed one per
+        # dispatch attempt by dist.guarded_dispatch via
+        # take_exchange_fault — window-keyed like every other kind, but
+        # delivered at exchange-dispatch time, which has no window in
+        # scope
+        self._stalls_pending = 0
+        self._loss_pending = False
         spec = (spec or "").strip()
         if spec:
             for part in spec.split(","):
@@ -242,6 +258,25 @@ class FaultPlan:
 
     def should_corrupt(self, window: int) -> bool:
         return self._fire("corrupt", window)
+
+    def arm_exchange_window(self, window: int) -> None:
+        """Move this window's ``stall``/``shard_loss`` events into the
+        pending slots the exchange-dispatch hook consumes."""
+        if self._fire("stall", window):
+            self._stalls_pending += 1
+        if self._fire("shard_loss", window):
+            self._loss_pending = True
+
+    def take_exchange_fault(self, op: str) -> Optional[str]:
+        """The dist.EXCHANGE_FAULT_HOOK body: one pending fault per
+        dispatch attempt, shard loss first (it preempts the window)."""
+        if self._loss_pending:
+            self._loss_pending = False
+            return "shard_loss"
+        if self._stalls_pending > 0:
+            self._stalls_pending -= 1
+            return "stall"
+        return None
 
     def take_io_fault(self) -> bool:
         if self.io_budget > 0:
@@ -383,6 +418,10 @@ def save_generation(qureg, ckpt_dir: str, cursor: int, *,
         "fingerprint": fingerprint,
         "rng": _rng.GLOBAL_RNG.get_state(),
         "measure_keys": M.KEYS.get_state(),
+        # the writing mesh's shard count: informational for the elastic
+        # restore path (load_latest reshards onto whatever mesh loads it;
+        # strict_mesh=True refuses any difference)
+        "mesh_shards": int(qureg.num_chunks),
     })
     retry_io(CKPT._write_meta, gen, meta, what="saveQureg(meta)")
     # ---- commit point ----
@@ -449,25 +488,70 @@ def _prune_generations(ckpt_dir: str, keep: int) -> None:
         shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
-def _load_generation(ckpt_dir: str, cursor: int, env):
+def _validated_perm(perm, n: int):
+    """Re-derive the carried logical->physical permutation for a restore:
+    the perm is a bit-level permutation of the GLOBAL amplitude index, so
+    it is valid on ANY mesh shape unchanged — what changes across meshes
+    is only which of its positions are shard-coordinate bits, and every
+    consumer (remap_sharded, the window planner) derives that from the
+    live mesh.  Malformed values (wrong length, not a permutation — a
+    torn metadata write) raise ValueError so load_latest treats the
+    generation as corrupt and falls back."""
+    if perm is None:
+        return None
+    perm = tuple(int(p) for p in perm)
+    if sorted(perm) != list(range(n)):
+        raise ValueError(
+            f"checkpoint perm {perm!r} is not a permutation of "
+            f"range({n})")
+    return perm
+
+
+def _load_generation(ckpt_dir: str, cursor: int, env, *,
+                     strict_mesh: bool = False):
     from . import checkpoint as CKPT
 
     gen = os.path.join(ckpt_dir, _gen_name(cursor))
     meta = CKPT._read_meta(gen)
+    saved_shards = meta.get("mesh_shards")
+    if saved_shards is not None and int(saved_shards) != env.num_devices:
+        if strict_mesh:
+            raise QuESTError(
+                "load_latest: checkpoint mesh mismatch — generation "
+                f"{_gen_name(cursor)} was written on {saved_shards} "
+                f"shards but this environment has {env.num_devices} "
+                "devices, and strict_mesh=True refuses elastic restore")
+        # elastic restore: _restore_amps below hands orbax the TARGET
+        # sharding, so the global (2, 2^n) payload reshards on read —
+        # the physical amplitude layout is mesh-shape-independent
+        # (leading index bits), only its partition moves
+        _telemetry.inc("elastic_restores_total")
+        _log_event(meta.get("fingerprint", "")[:12] or "-", "elastic_restore",
+                   cursor=int(meta.get("cursor", 0)),
+                   from_shards=int(saved_shards),
+                   to_shards=int(env.num_devices))
     q = CKPT._qureg_from_meta(meta, env)
     amps = CKPT._restore_amps(gen, q)
-    perm = meta.get("perm")
-    q._set_amps_permuted(amps, tuple(perm) if perm else None)
+    perm = _validated_perm(meta.get("perm"), q.num_qubits_in_state_vec)
+    q._set_amps_permuted(amps, perm)
     return q, meta
 
 
-def load_latest(ckpt_dir: str, env):
+def load_latest(ckpt_dir: str, env, *, strict_mesh: bool = False):
     """Load the newest loadable committed generation under ``ckpt_dir``.
     Returns (qureg, meta) or None when no checkpoint exists.  A corrupt
     newest generation (torn write, bad disk) falls back to its
     predecessor with a warning; genuine environment mismatches
     (precision/qubit count vs this env) are surfaced as QuESTError, not
-    swallowed."""
+    swallowed.
+
+    Restore is ELASTIC by default: a generation written on an M-shard
+    mesh loads onto ``env``'s N-shard mesh for any power-of-two N the
+    register can shard over (including N=1) — the raw amplitude payload
+    reshards on read and the carried perm/cursor/RNG state are
+    re-derived/validated (docs/design.md §19).  ``strict_mesh=True``
+    restores the old behavior: any shard-count difference is a
+    structured QuESTError."""
     ckpt_dir = os.path.abspath(ckpt_dir)
     if not os.path.isdir(ckpt_dir):
         return None
@@ -487,7 +571,8 @@ def load_latest(ckpt_dir: str, env):
     last_err = None
     for cursor in candidates:
         try:
-            loaded = _load_generation(ckpt_dir, cursor, env)
+            loaded = _load_generation(ckpt_dir, cursor, env,
+                                      strict_mesh=strict_mesh)
             _telemetry.inc("checkpoint_restores_total")
             return loaded
         except QuESTError:
@@ -510,7 +595,8 @@ def load_latest(ckpt_dir: str, env):
 
 def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
                   watchdog: str = "raise",
-                  faults: Optional[FaultPlan] = None):
+                  faults: Optional[FaultPlan] = None,
+                  elastic: bool = True):
     """Execute ``gates`` (a sequence of :class:`quest_tpu.circuit.Gate`,
     or ``(targets, mat)`` pairs, on state-vector bit positions) on
     ``qureg`` in fusion windows of ``every`` gates, checkpointing at every
@@ -526,9 +612,19 @@ def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
 
     ``watchdog``: one of ``raise`` / ``renormalize`` / ``rollback``
     (see module docstring).  ``faults``: a :class:`FaultPlan`; defaults
-    to ``QT_FAULT_PLAN`` when set.  Returns ``qureg``."""
+    to ``QT_FAULT_PLAN`` when set.  Returns ``qureg``.
+
+    ``elastic`` (default True) enables degraded-mesh failover: when a
+    guarded exchange dispatch declares a shard dead
+    (dist.ShardLossError), the run rolls back to the last-good
+    generation, shrinks the mesh to the surviving half (halving until a
+    single device remains), reshards the rolled-back state onto it via
+    the elastic restore path, records the event (failovers_total,
+    degradation registry, a ``failover`` JSON log line with the
+    detect/rollback/reshard phase breakdown), and resumes.  Requires at
+    least one committed generation to roll back to; with ``elastic=False``
+    or on a single-device mesh the ShardLossError propagates."""
     from . import circuit as C
-    from . import fusion as _fusion
 
     if watchdog not in WATCHDOG_POLICIES:
         raise QuESTError(
@@ -560,36 +656,125 @@ def run_resumable(qureg, gates: Sequence, ckpt_dir: str, *, every: int = 64,
                    generation=_gen_name(start), window=start // every,
                    elapsed=round(time.perf_counter() - t_run, 4))
 
+    from .parallel import dist as PAR
+
     _ACTIVE_FAULTS[0] = faults
+    PAR.EXCHANGE_FAULT_HOOK[0] = (faults.take_exchange_fault
+                                  if faults is not None else None)
+    # mutable per-attempt markers for the failover MTTR phases: the
+    # executor stamps when the current window began (detect = time from
+    # there to the ShardLossError catch) and, after a failover, when the
+    # first post-resume window completes (the resume phase)
+    marks = {"window_started": None, "resume_from": None}
     try:
-        boundaries = C.plan_checkpoint_boundaries(len(glist), every,
-                                                  start=start)
-        cursor = start
-        for end in boundaries:
-            window = cursor // every
-            if faults is not None:
-                faults.maybe_kill(window)
-            _fusion.start_gate_fusion(qureg)
+        while True:
             try:
-                qureg._fusion.gates.extend(glist[cursor:end])
-            finally:
-                _fusion.stop_gate_fusion(qureg)  # drain: the window pass
-            if faults is not None:
-                faults.maybe_corrupt_amps(qureg, window)
-            _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end),
-                           log_ctx=(run_id, t_run))
-            cursor = end
-            t_ck = time.perf_counter()
-            with _telemetry.span("resilience.checkpoint", window=window):
-                save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
-                                faults=faults, window=window)
-            _log_event(run_id, "checkpoint", window=window, cursor=cursor,
-                       generation=_gen_name(cursor),
-                       seconds=round(time.perf_counter() - t_ck, 4),
-                       elapsed=round(time.perf_counter() - t_run, 4))
-        return qureg
+                _execute_windows(qureg, glist, ckpt_dir, every=every,
+                                 watchdog=watchdog, faults=faults, fp=fp,
+                                 run_id=run_id, t_run=t_run, start=start,
+                                 marks=marks)
+                return qureg
+            except PAR.ShardLossError as err:
+                start = _failover(qureg, ckpt_dir, err, run_id=run_id,
+                                  t_run=t_run, elastic=elastic,
+                                  window_started=marks["window_started"])
+                marks["resume_from"] = time.perf_counter()
     finally:
         _ACTIVE_FAULTS[0] = None
+        PAR.EXCHANGE_FAULT_HOOK[0] = None
+
+
+def _execute_windows(qureg, glist, ckpt_dir: str, *, every: int,
+                     watchdog: str, faults: Optional[FaultPlan], fp: str,
+                     run_id: str, t_run: float, start: int,
+                     marks: dict) -> None:
+    """One pass of run_resumable's window loop from gate ``start`` to the
+    end of ``glist`` on qureg's CURRENT mesh — factored out so the
+    failover path can re-enter it after a rollback + mesh shrink."""
+    from . import circuit as C
+    from . import fusion as _fusion
+
+    boundaries = C.plan_checkpoint_boundaries(len(glist), every, start=start)
+    cursor = start
+    for end in boundaries:
+        window = cursor // every
+        if faults is not None:
+            faults.maybe_kill(window)
+            faults.arm_exchange_window(window)
+        marks["window_started"] = time.perf_counter()
+        _fusion.start_gate_fusion(qureg)
+        try:
+            qureg._fusion.gates.extend(glist[cursor:end])
+        finally:
+            _fusion.stop_gate_fusion(qureg)  # drain: the window pass
+        if marks["resume_from"] is not None:
+            _telemetry.set_gauge("failover_resume_seconds",
+                                 time.perf_counter() - marks["resume_from"])
+            marks["resume_from"] = None
+        if faults is not None:
+            faults.maybe_corrupt_amps(qureg, window)
+        _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end),
+                       log_ctx=(run_id, t_run))
+        cursor = end
+        t_ck = time.perf_counter()
+        with _telemetry.span("resilience.checkpoint", window=window):
+            save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
+                            faults=faults, window=window)
+        _log_event(run_id, "checkpoint", window=window, cursor=cursor,
+                   generation=_gen_name(cursor),
+                   seconds=round(time.perf_counter() - t_ck, 4),
+                   elapsed=round(time.perf_counter() - t_run, 4))
+
+
+def _failover(qureg, ckpt_dir: str, err, *, run_id: str, t_run: float,
+              elastic: bool, window_started: Optional[float]) -> int:
+    """Degraded-mesh failover: roll the register back to the last-good
+    generation RESHARDED onto a mesh of the surviving half of the
+    devices, and return the gate cursor to resume from.  Re-raises the
+    ShardLossError when failover is disabled, the mesh is already a
+    single device, or no committed generation exists to roll back to."""
+    from . import env as _env
+
+    t_detect = time.perf_counter()
+    old_n = qureg.env.num_devices
+    if not elastic or old_n <= 1:
+        raise err
+    new_n = old_n // 2
+    detect_s = (t_detect - window_started) if window_started else 0.0
+    # rollback: pick + read the last-good generation, restoring its raw
+    # payload directly into the SHRUNKEN mesh's sharding (the elastic
+    # path — one restore does both the rollback and the reshard IO)
+    t0 = time.perf_counter()
+    new_env = _env.shrink_env(qureg.env, new_n)
+    loaded = load_latest(ckpt_dir, new_env)
+    rollback_s = time.perf_counter() - t0
+    if loaded is None:
+        raise QuESTError(
+            f"run_resumable: shard loss during {err.op!r} dispatch but no "
+            f"committed generation exists under {ckpt_dir} to roll back "
+            "to; cannot fail over") from err
+    # reshard: rebind the register to the degraded mesh + restored state
+    t1 = time.perf_counter()
+    restored, meta = loaded
+    qureg.env = new_env
+    _restore_into(qureg, restored, meta)
+    cursor = int(meta.get("cursor", 0))
+    reshard_s = time.perf_counter() - t1
+    _telemetry.inc("failovers_total")
+    _telemetry.set_gauge("failover_detect_seconds", detect_s)
+    _telemetry.set_gauge("failover_rollback_seconds", rollback_s)
+    _telemetry.set_gauge("failover_reshard_seconds", reshard_s)
+    record_degradation(
+        f"mesh_failover_{old_n}to{new_n}",
+        f"shard loss during {err.op!r} dispatch ({err}); mesh shrunk "
+        f"{old_n}->{new_n}, resumed from gate cursor {cursor}")
+    _log_event(run_id, "failover", op=err.op, from_shards=old_n,
+               to_shards=new_n, cursor=cursor,
+               detect_seconds=round(detect_s, 4),
+               rollback_seconds=round(rollback_s, 4),
+               reshard_seconds=round(reshard_s, 4),
+               elapsed=round(time.perf_counter() - t_run, 4))
+    return cursor
 
 
 def _restore_into(qureg, restored, meta) -> None:

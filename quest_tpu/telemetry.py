@@ -166,6 +166,12 @@ def set_gauge(name: str, value: float, /, **labels) -> None:
 # second-valued latencies, the explicit entries are size-valued
 _DEFAULT_BOUNDS = (1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 60.0)
 HIST_BOUNDS = {
+    # guarded-collective dispatch latency (dist.guarded_dispatch): finer
+    # low end than the default — a healthy CPU/ICI exchange dispatch sits
+    # in the 10us-10ms decades and the deadline policy needs resolution
+    # there
+    "exchange_latency_seconds": (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0,
+                                 60.0),
     "fusion_drain_gates": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
     "fusion_window_gates": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
     "fusion_remap_window_items": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,

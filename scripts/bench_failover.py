@@ -1,0 +1,143 @@
+"""MTTR of a degraded-mesh failover on the dryrun mesh.
+
+Runs the elastic-recovery brickwork workload through
+resilience.run_resumable with an injected ``shard_loss`` mid-run and
+reports the mean-time-to-recovery with its phase breakdown — the four
+gauges the failover path stamps (resilience._failover /
+_execute_windows):
+
+  detect    window start -> the guard's ShardLossError reaching the
+            driver (includes the retry budget the guard burned first)
+  rollback  picking + reading the last-good generation, resharded onto
+            the surviving mesh (one elastic restore does both IOs)
+  reshard   rebinding the register to the shrunken env + restored state
+  resume    the first post-failover window completing on the new mesh
+            (dominated by recompiling the window plans for the new
+            shard split)
+
+Also cross-checks the recovered state: the post-failover amplitudes must
+be bitwise those of an uninterrupted run on the shrunken mesh.
+
+Usage: python scripts/bench_failover.py [--n 10] [--depth 32] [--every 16]
+                                        [--window 2] [--reps 3]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("QT_RETRY_BASE_SECONDS", "0.001")
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import circuit as C  # noqa: E402
+from quest_tpu import resilience as R  # noqa: E402
+from quest_tpu import telemetry as T  # noqa: E402
+
+PHASES = ("detect", "rollback", "reshard", "resume")
+
+
+def _arg(flag, default):
+    return int(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def _gates(n, depth):
+    rng = np.random.default_rng(11)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    soa = np.stack([u.real, u.imag])
+    gates = []
+    for _ in range(depth):
+        gates.append(C.Gate((0, 1), soa))          # shard-local
+        gates.append(C.Gate((n - 2, n - 1), soa))  # sharded targets
+    return gates
+
+
+def _phase_gauges():
+    return {p: float(T._GAUGES.get((f"failover_{p}_seconds", ()), 0.0))
+            for p in PHASES}
+
+
+def main():
+    n = _arg("--n", 10)
+    depth = _arg("--depth", 32)
+    every = _arg("--every", 16)
+    window = _arg("--window", 2)
+    reps = _arg("--reps", 3)
+    T.configure("on")
+    env = qt.createQuESTEnv()
+    gates = _gates(n, depth)
+
+    # reference: uninterrupted run on the mesh the failover shrinks TO
+    target = qt.createQuESTEnv(num_devices=env.num_devices // 2)
+    qt.seedQuEST(target, [3])
+    q_ref = qt.createQureg(n, target)
+    d_ref = tempfile.mkdtemp(prefix="qt_bench_fo_ref_")
+    try:
+        qt.run_resumable(q_ref, gates, d_ref, every=every)
+        ref = np.asarray(q_ref.amps)
+    finally:
+        shutil.rmtree(d_ref, ignore_errors=True)
+
+    samples = []
+    bitwise_ok = True
+    total_s = []
+    for rep in range(reps):
+        R.DEGRADATIONS.pop(
+            f"mesh_failover_{env.num_devices}to{target.num_devices}", None)
+        qt.seedQuEST(env, [3])
+        q = qt.createQureg(n, env)
+        d = tempfile.mkdtemp(prefix="qt_bench_fo_")
+        t0 = time.perf_counter()
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                qt.run_resumable(q, gates, d, every=every,
+                                 faults=qt.FaultPlan(f"shard_loss@{window}"))
+            total_s.append(time.perf_counter() - t0)
+            bitwise_ok &= bool(np.array_equal(np.asarray(q.amps), ref))
+            samples.append(_phase_gauges())
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    mttr = [sum(s.values()) for s in samples]
+    out = {
+        "metric": f"{n}q depth-{depth} shard-loss failover MTTR "
+                  f"(every={every}, window={window})",
+        "reps": reps,
+        "devices_before": env.num_devices,
+        "devices_after": target.num_devices,
+        "recovered_bitwise_vs_target_mesh": bitwise_ok,
+        "mttr_seconds_best": round(min(mttr), 4),
+        "mttr_seconds_median": round(sorted(mttr)[len(mttr) // 2], 4),
+        "phases_best": {p: round(min(s[p] for s in samples), 4)
+                        for p in PHASES},
+        "phases_median": {
+            p: round(sorted(s[p] for s in samples)[len(samples) // 2], 4)
+            for p in PHASES},
+        "run_seconds_median": round(sorted(total_s)[len(total_s) // 2], 4),
+        "failovers_total": int(T.counter_total("failovers_total")),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,23 +56,29 @@ class TestOrbaxCheckpoint:
         np.testing.assert_allclose(np.asarray(q2.amps), np.asarray(q.amps),
                                    atol=0)
 
-    def test_mesh_grown_past_shardable_size_raises(self, env, tmp_path):
+    def test_mesh_grown_past_shardable_size_strict_raises(self, env,
+                                                          tmp_path):
         """A register too small to put one amplitude on each device of a
-        GROWN mesh is refused with both sides named."""
-        import quest_tpu.checkpoint as CKPT
-
+        GROWN mesh: strict_mesh=True keeps the old refusal with both
+        sides named; the default now auto-shrinks onto a usable sub-mesh
+        (elastic restore — tests/test_elastic.py TestLoadQuregElastic)."""
         if env.num_devices < 2:
             pytest.skip("needs a multi-device mesh")
-        q = qt.createQureg(4, env)
+        q = qt.createQureg(1, env)  # 2 amps < 8 devices
         qt.saveQureg(q, str(tmp_path / "ckpt"))
-        meta = CKPT._read_meta(str(tmp_path / "ckpt"))
-        meta["num_qubits_represented"] = 1  # as if saved on a tiny mesh
-        CKPT._write_meta(str(tmp_path / "ckpt"), meta)
         with pytest.raises(qt.QuESTError) as ei:
-            qt.loadQureg(str(tmp_path / "ckpt"), env)
+            qt.loadQureg(str(tmp_path / "ckpt"), env, strict_mesh=True)
         msg = str(ei.value)
         assert "mesh has grown" in msg
         assert f"{env.num_devices} devices" in msg
+        from quest_tpu import resilience as R
+
+        with pytest.warns(UserWarning, match="loadQureg_mesh_"):
+            q2 = qt.loadQureg(str(tmp_path / "ckpt"), env)
+        assert q2.env.num_devices == 2
+        np.testing.assert_array_equal(np.asarray(q2.amps),
+                                      np.asarray(q.amps))
+        R.DEGRADATIONS.pop(f"loadQureg_mesh_{env.num_devices}to2", None)
 
     def test_transient_io_error_retried(self, env, tmp_path, monkeypatch):
         """saveQureg rides the bounded-backoff retry wrapper: two

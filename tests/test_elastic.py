@@ -1,0 +1,458 @@
+"""Elastic recovery suite (ISSUE 6): mesh-portable checkpoints, guarded
+collectives, and degraded-mesh failover.
+
+Pins the acceptance contract:
+
+* a checkpoint written on an 8-shard mesh RESUMES on 4 / 1 / 16 shards
+  BIT-IDENTICAL to an uninterrupted run on the target mesh — including a
+  live non-identity logical->physical permutation at the kill point and
+  the measurement-RNG state (the restore must overwrite a reseed);
+* ``strict_mesh=True`` preserves the old refusal on any shard-count
+  difference (both load_latest and loadQureg);
+* runtime-config drift between save and resume (QT_EXCHANGE_CHUNKS,
+  QT_TELEMETRY) does not perturb the resumed amplitudes;
+* a corrupt LATEST pointer / corrupt or perm-garbled newest generation
+  falls back on the elastic path exactly as on the same-mesh path;
+* an injected ``shard_loss`` mid-run triggers automatic rollback + mesh
+  shrink + resume with a correct final state, observable via
+  failovers_total, the MTTR phase gauges, the degradation registry, and
+  getEnvironmentString; an injected ``stall`` is absorbed by the guard's
+  retry budget without failover.
+
+Marked ``slow``: the tier-1 gate (-m 'not slow') runs within a hard
+wall-clock budget the seed suite nearly fills; this suite's full
+save/resume cycles run under ``make verify-elastic`` and
+``make verify-faults`` instead.  The cheap unit contracts (guarded
+dispatch, FaultPlan arming, _validated_perm) stay tier-1 in
+test_resilience.py.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu import fusion as F
+from quest_tpu import resilience as R
+from quest_tpu import rng as qt_rng
+from quest_tpu import telemetry as T
+from quest_tpu.parallel import dist as PAR
+
+pytestmark = [pytest.mark.faults, pytest.mark.slow]
+
+N = 6  # 64 amps: shardable over 1..16 devices with local qubits to spare
+
+H_SOA = np.stack([(1 / np.sqrt(2)) * np.array([[1.0, 1], [1, -1]]),
+                  np.zeros((2, 2))])
+CX_SOA = np.stack([
+    np.array([[1.0, 0, 0, 0], [0, 1, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0]]),
+    np.zeros((4, 4)),
+])
+
+EVERY = 8
+KILL_CURSOR = 3 * EVERY  # kill@3 -> last committed generation is gen 24
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry(monkeypatch):
+    monkeypatch.setenv("QT_RETRY_BASE_SECONDS", "0.001")
+
+
+def _circuit(n=N, depth=4):
+    """Entangling brickwork touching the sharded high qubits, so window
+    drains leave a live permutation behind (same shape as
+    test_resilience's)."""
+    gates = []
+    for _ in range(depth):
+        for t in range(n):
+            gates.append(CIRC.Gate((t,), H_SOA))
+        for t in range(n - 1):
+            gates.append(CIRC.Gate((t, t + 1), CX_SOA))
+    return gates
+
+
+def _fresh(env, n=N, seed=7):
+    qt.seedQuEST(env, [seed])
+    return qt.createQureg(n, env)
+
+
+@pytest.fixture(scope="module")
+def env4():
+    return qt.createQuESTEnv(num_devices=4)
+
+
+@pytest.fixture(scope="module")
+def env1():
+    return qt.createQuESTEnv(num_devices=1)
+
+
+@pytest.fixture(scope="module")
+def ref4(env4, tmp_path_factory):
+    """Uninterrupted 4-device run: final amplitudes + the next host
+    uniform draw (the RNG-state anchor for the elastic resumes)."""
+    q = _fresh(env4)
+    qt.run_resumable(q, _circuit(), str(tmp_path_factory.mktemp("ref4")),
+                     every=EVERY)
+    return np.asarray(q.amps), qt_rng.GLOBAL_RNG.uniform()
+
+
+@pytest.fixture(scope="module")
+def killed8(env, tmp_path_factory):
+    """A checkpoint dir left by an 8-device run preempted before window 3
+    — the source every elastic resume restores from (copied per test, so
+    each resume genuinely starts mid-circuit)."""
+    d = str(tmp_path_factory.mktemp("killed8"))
+    q = _fresh(env)
+    with pytest.raises(qt.SimulatedPreemption):
+        qt.run_resumable(q, _circuit(), d, every=EVERY,
+                         faults=qt.FaultPlan("kill@3"))
+    return d
+
+
+def _copy(src: str, tmp_path) -> str:
+    dst = str(tmp_path / "ckpt")
+    shutil.copytree(src, dst)
+    return dst
+
+
+def _resume(target_env, ckpt_dir: str, seed=999):
+    """Resume the killed run on ``target_env``; the deliberately WRONG
+    seed proves the restore overwrites the live RNG state."""
+    q = _fresh(target_env, seed=seed)
+    qt.run_resumable(q, _circuit(), ckpt_dir, every=EVERY)
+    return q
+
+
+class TestElasticResume:
+    def test_killed_checkpoint_has_live_perm_and_mesh_meta(self, killed8,
+                                                           env):
+        """The source checkpoint really exercises the hard case: a
+        non-identity logical->physical permutation, mid-circuit cursor,
+        and the writing mesh's shard count in the metadata."""
+        q, meta = R.load_latest(killed8, env)
+        assert meta["cursor"] == KILL_CURSOR
+        assert meta["mesh_shards"] == 8
+        perm = meta["perm"]
+        assert perm is not None
+        assert sorted(perm) == list(range(N))
+        assert perm != list(range(N))
+        assert q._perm == tuple(perm)
+
+    def test_resume_8_to_4_bit_identical(self, killed8, env4, ref4,
+                                         tmp_path):
+        before = T.counter_total("elastic_restores_total")
+        q = _resume(env4, _copy(killed8, tmp_path))
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+        # the checkpointed RNG state (seed 7) overwrote the seed-999
+        # reseed, so the post-run draw matches the uninterrupted run's
+        assert qt_rng.GLOBAL_RNG.uniform() == ref4[1]
+        assert T.counter_total("elastic_restores_total") > before
+
+    def test_resume_8_to_1_bit_identical(self, killed8, env1, ref4,
+                                         tmp_path):
+        q = _resume(env1, _copy(killed8, tmp_path))
+        assert q.env.num_devices == 1
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+        assert qt_rng.GLOBAL_RNG.uniform() == ref4[1]
+
+    def test_same_mesh_resume_unchanged(self, killed8, env, ref4, tmp_path):
+        """The elastic machinery must not perturb the classic same-mesh
+        resume (8->8 == uninterrupted 4-dev run by cross-mesh equality)."""
+        q = _resume(env, _copy(killed8, tmp_path))
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+    def test_strict_mesh_refuses_shard_count_change(self, killed8, env,
+                                                    env4):
+        with pytest.raises(qt.QuESTError, match="mesh mismatch"):
+            R.load_latest(killed8, env4, strict_mesh=True)
+        # same mesh still loads under strict
+        q, meta = R.load_latest(killed8, env, strict_mesh=True)
+        assert meta["cursor"] == KILL_CURSOR
+
+
+_ELASTIC_16 = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["QT_RETRY_BASE_SECONDS"] = "0.001"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC, resilience as R
+
+qt.set_precision(2)
+N = 6
+H = np.stack([(1/np.sqrt(2))*np.array([[1.0,1],[1,-1]]), np.zeros((2,2))])
+CX = np.stack([np.array([[1.0,0,0,0],[0,1,0,0],[0,0,0,1],[0,0,1,0]]),
+               np.zeros((4,4))])
+def circ(depth=4):
+    g = []
+    for _ in range(depth):
+        for t in range(N): g.append(CIRC.Gate((t,), H))
+        for t in range(N-1): g.append(CIRC.Gate((t,t+1), CX))
+    return g
+
+env16 = qt.createQuESTEnv()
+assert env16.num_ranks == 16, env16.num_ranks
+env8 = qt.createQuESTEnv(num_devices=8)
+
+qt.seedQuEST(env16, [7]); q16 = qt.createQureg(N, env16)
+qt.run_resumable(q16, circ(), "ref16", every=8)
+a16 = np.asarray(q16.amps)
+
+qt.seedQuEST(env8, [7]); q = qt.createQureg(N, env8)
+try:
+    qt.run_resumable(q, circ(), "killed8", every=8,
+                     faults=qt.FaultPlan("kill@3"))
+    raise SystemExit("kill did not fire")
+except qt.SimulatedPreemption:
+    pass
+_, meta = R.load_latest("killed8", env8)
+assert meta["mesh_shards"] == 8 and meta["cursor"] == 24, meta
+assert meta["perm"] is not None and meta["perm"] != list(range(N)), meta
+
+qt.seedQuEST(env16, [999]); q2 = qt.createQureg(N, env16)
+qt.run_resumable(q2, circ(), "killed8", every=8)
+assert np.array_equal(np.asarray(q2.amps), a16)
+print("ELASTIC16 OK 8->16 bitwise")
+"""
+
+
+def test_resume_8_to_16_bit_identical(tmp_path):
+    """The growing direction needs more devices than the in-process
+    virtual backend holds, so it runs in a 16-device subprocess (same
+    pattern as test_mesh_sweep's 16-device smoke)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env.pop("JAX_PLATFORMS", None)
+    code = _ELASTIC_16.format(repo=repo)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd=str(tmp_path), capture_output=True,
+                          text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "ELASTIC16 OK 8->16 bitwise" in proc.stdout
+
+
+class TestConfigDrift:
+    """Runtime config changed between save and resume must not perturb
+    the resumed amplitudes (the checkpoint carries STATE, not config)."""
+
+    def test_exchange_chunks_drift(self, env, env4, ref4, tmp_path,
+                                   monkeypatch):
+        d = str(tmp_path / "ck")
+        monkeypatch.setenv("QT_EXCHANGE_CHUNKS", "2")
+        q = _fresh(env)
+        with pytest.raises(qt.SimulatedPreemption):
+            qt.run_resumable(q, _circuit(), d, every=EVERY,
+                             faults=qt.FaultPlan("kill@3"))
+        monkeypatch.delenv("QT_EXCHANGE_CHUNKS")
+        q2 = _resume(env4, d)
+        assert np.array_equal(np.asarray(q2.amps), ref4[0])
+
+    def test_telemetry_mode_drift(self, env, env4, ref4, tmp_path):
+        d = str(tmp_path / "ck")
+        old = T.mode_name()
+        try:
+            T.configure("on")
+            q = _fresh(env)
+            with pytest.raises(qt.SimulatedPreemption):
+                qt.run_resumable(q, _circuit(), d, every=EVERY,
+                                 faults=qt.FaultPlan("kill@3"))
+            T.configure("off")
+            q2 = _resume(env4, d)
+        finally:
+            T.configure(old)
+        assert np.array_equal(np.asarray(q2.amps), ref4[0])
+
+
+class TestElasticFallbacks:
+    """Corruption handling must be no weaker on the cross-mesh path."""
+
+    def test_corrupt_latest_pointer(self, killed8, env4, ref4, tmp_path):
+        d = _copy(killed8, tmp_path)
+        with open(os.path.join(d, "LATEST"), "w") as f:
+            f.write("gen-NOT-A-CURSOR\n")
+        q = _resume(env4, d)
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+    def test_corrupt_newest_generation_falls_back(self, killed8, env4,
+                                                  ref4, tmp_path):
+        d = _copy(killed8, tmp_path)
+        R._corrupt_generation(os.path.join(d, R._gen_name(KILL_CURSOR)))
+        with pytest.warns(UserWarning, match="unreadable"):
+            q = _resume(env4, d)
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+    def test_missing_newest_generation_falls_back(self, killed8, env4,
+                                                  ref4, tmp_path):
+        d = _copy(killed8, tmp_path)
+        shutil.rmtree(os.path.join(d, R._gen_name(KILL_CURSOR)))
+        q = _resume(env4, d)
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+    def test_garbled_perm_treated_as_corrupt(self, killed8, env4, ref4,
+                                             tmp_path):
+        """A torn metadata write that mangles the carried permutation must
+        fall back to the predecessor, not restore a wrong bit layout."""
+        import json
+
+        d = _copy(killed8, tmp_path)
+        meta_path = os.path.join(d, R._gen_name(KILL_CURSOR),
+                                 "qureg_meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta["perm"] = [0] * N  # not a permutation
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with pytest.warns(UserWarning, match="unreadable"):
+            q = _resume(env4, d)
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+@pytest.fixture
+def _clean_failover_state():
+    """Failover records a process-global degradation (warned once per
+    name); drop the keys afterwards so runs stay independent."""
+    yield
+    for key in list(R.DEGRADATIONS):
+        if key.startswith(("mesh_failover_", "loadQureg_mesh_")):
+            del R.DEGRADATIONS[key]
+
+
+class TestFailover:
+    def test_shard_loss_triggers_rollback_shrink_resume(
+            self, env, ref4, tmp_path, _clean_failover_state):
+        before = T.counter_total("failovers_total")
+        q = _fresh(env)
+        plan = qt.FaultPlan("shard_loss@2")
+        with pytest.warns(UserWarning, match="mesh_failover_8to4"):
+            qt.run_resumable(q, _circuit(), str(tmp_path / "ck"),
+                             every=EVERY, faults=plan)
+        assert plan.log == ["shard_loss@2"]
+        # the run completed on the surviving half of the mesh...
+        assert q.env.num_devices == 4
+        # ...with the uninterrupted 4-device run's exact amplitudes
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+        assert T.counter_total("failovers_total") == before + 1
+        assert "mesh_failover_8to4" in qt.degradation_report()
+        # MTTR phase gauges: detect -> rollback -> reshard -> resume
+        gauges = T.snapshot()["gauges"]
+        for phase in ("detect", "rollback", "reshard", "resume"):
+            name = f"failover_{phase}_seconds"
+            assert name in gauges, f"missing MTTR gauge {name}"
+            assert list(gauges[name].values())[0] >= 0.0
+        # observable without touching telemetry internals
+        assert "Failovers=" in qt.getEnvironmentString(env)
+
+    def test_stall_absorbed_by_retry_budget(self, env, ref4, tmp_path):
+        before = T.counter_total("exchange_timeouts_total")
+        q = _fresh(env)
+        plan = qt.FaultPlan("stall@1")
+        qt.run_resumable(q, _circuit(), str(tmp_path / "ck"), every=EVERY,
+                         faults=plan)
+        assert plan.log == ["stall@1"]
+        assert q.env.num_devices == 8  # no failover
+        assert T.counter_total("exchange_timeouts_total") > before
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+
+    def test_elastic_false_propagates_shard_loss(self, env, tmp_path):
+        q = _fresh(env)
+        with pytest.raises(PAR.ShardLossError):
+            qt.run_resumable(q, _circuit(), str(tmp_path / "ck"),
+                             every=EVERY, faults=qt.FaultPlan("shard_loss@2"),
+                             elastic=False)
+
+    def test_shard_loss_before_first_checkpoint_raises(self, env, tmp_path):
+        """No committed generation to roll back to -> a structured error,
+        not a silent restart from |0...0>."""
+        q = _fresh(env)
+        with pytest.raises(qt.QuESTError, match="cannot fail over"):
+            qt.run_resumable(q, _circuit(), str(tmp_path / "ck"),
+                             every=EVERY, faults=qt.FaultPlan("shard_loss@0"))
+
+    def test_exchange_latency_histogram_recorded(self, env, tmp_path):
+        q = _fresh(env)
+        qt.run_resumable(q, _circuit(), str(tmp_path / "ck"), every=EVERY)
+        hists = T.snapshot()["histograms"]
+        assert "exchange_latency_seconds" in hists
+        assert any("op=" in k for k in hists["exchange_latency_seconds"])
+
+
+class TestLoadQuregElastic:
+    def test_cross_mesh_roundtrip(self, env, env4, tmp_path):
+        q = _fresh(env)
+        qt.hadamard(q, 0)
+        qt.controlledNot(q, 0, N - 1)
+        want = np.asarray(q.amps)
+        qt.saveQureg(q, str(tmp_path / "ck"))
+        before = T.counter_total("elastic_restores_total")
+        q2 = qt.loadQureg(str(tmp_path / "ck"), env4)
+        assert q2.env.num_devices == 4
+        assert np.array_equal(np.asarray(q2.amps), want)
+        assert T.counter_total("elastic_restores_total") > before
+
+    def test_strict_mesh_refuses_shard_count_change(self, env, env4,
+                                                    tmp_path):
+        q = _fresh(env)
+        qt.saveQureg(q, str(tmp_path / "ck"))
+        with pytest.raises(qt.QuESTError, match="mesh mismatch"):
+            qt.loadQureg(str(tmp_path / "ck"), env4, strict_mesh=True)
+        # same mesh still loads under strict
+        q2 = qt.loadQureg(str(tmp_path / "ck"), env, strict_mesh=True)
+        assert np.array_equal(np.asarray(q2.amps), np.asarray(q.amps))
+
+    def test_tiny_register_auto_shrinks_grown_mesh(
+            self, env, tmp_path, _clean_failover_state):
+        """A 2-qubit register (4 amps) saved then loaded on the 8-device
+        env: the old structured error becomes an automatic reshard onto
+        the largest usable sub-mesh, recorded as a degradation."""
+        q = qt.createQureg(2, env)
+        qt.hadamard(q, 0)
+        want = np.asarray(q.amps)
+        qt.saveQureg(q, str(tmp_path / "ck"))
+        with pytest.warns(UserWarning, match="loadQureg_mesh_8to4"):
+            q2 = qt.loadQureg(str(tmp_path / "ck"), env)
+        assert q2.env.num_devices == 4
+        assert np.array_equal(np.asarray(q2.amps), want)
+        assert "loadQureg_mesh_8to4" in qt.degradation_report()
+
+    def test_tiny_register_strict_keeps_grown_error(self, env, tmp_path):
+        q = qt.createQureg(2, env)
+        qt.saveQureg(q, str(tmp_path / "ck"))
+        with pytest.raises(qt.QuESTError, match="mesh has grown"):
+            qt.loadQureg(str(tmp_path / "ck"), env, strict_mesh=True)
+
+
+class TestLiveReshard:
+    def test_reshard_to_carries_live_perm(self, env, env4):
+        """Qureg.reshard_to moves a register with a live permutation onto
+        a smaller mesh without rematerializing canonical order — the
+        canonical read afterwards matches a same-gates run on the target
+        mesh bitwise."""
+        gates = _circuit()[:KILL_CURSOR]
+        q = _fresh(env)
+        F.start_gate_fusion(q)
+        q._fusion.gates.extend(gates)
+        F.stop_gate_fusion(q)
+        assert q._perm is not None  # the interesting case
+
+        q.reshard_to(env4)
+        assert q.env is env4
+        assert q._perm is not None  # carried, not rematerialized
+
+        want = _fresh(env4)
+        F.start_gate_fusion(want)
+        want._fusion.gates.extend(gates)
+        F.stop_gate_fusion(want)
+        assert np.array_equal(np.asarray(q.amps), np.asarray(want.amps))
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -396,6 +396,132 @@ class TestFaultPlanParsing:
         with pytest.raises(qt.QuESTError, match="unknown fault kind"):
             qt.FaultPlan("meteor@3")
 
+    def test_exchange_fault_kinds_parse(self):
+        plan = qt.FaultPlan("stall@2, shard_loss@3")
+        assert ("stall", 2) in plan.events
+        assert ("shard_loss", 3) in plan.events
+
+    def test_arm_and_take_exchange_faults(self):
+        """Window-keyed arming moves stall/shard_loss into the pending
+        slots the dispatch hook drains — shard loss first (it preempts
+        the window), one fault per dispatch attempt, then clean."""
+        plan = qt.FaultPlan("stall@1, shard_loss@1")
+        assert plan.take_exchange_fault("drain") is None  # nothing armed
+        plan.arm_exchange_window(0)
+        assert plan.take_exchange_fault("drain") is None  # wrong window
+        plan.arm_exchange_window(1)
+        assert plan.take_exchange_fault("drain") == "shard_loss"
+        assert plan.take_exchange_fault("drain") == "stall"
+        assert plan.take_exchange_fault("drain") is None
+        assert plan.log == ["stall@1", "shard_loss@1"]
+
+
+@pytest.fixture
+def _no_fault_hook():
+    """Isolate guarded_dispatch tests from any leftover injection hook,
+    and clean up the ones they install."""
+    from quest_tpu.parallel import dist as PAR
+
+    old = PAR.EXCHANGE_FAULT_HOOK[0]
+    PAR.EXCHANGE_FAULT_HOOK[0] = None
+    yield PAR
+    PAR.EXCHANGE_FAULT_HOOK[0] = old
+
+
+class TestGuardedDispatch:
+    """Unit contract of dist.guarded_dispatch (the collective guard the
+    elastic failover path is built on — tests/test_elastic.py drives it
+    end to end through run_resumable)."""
+
+    def test_passthrough_and_latency_histogram(self, _no_fault_hook):
+        PAR = _no_fault_hook
+        from quest_tpu import telemetry as T
+
+        hist_key = ("exchange_latency_seconds",
+                    (("op", "unit_test"), ("shards", "8")))
+        T._HISTS.pop(hist_key, None)
+        out = PAR.guarded_dispatch(lambda a, k=None: (a, k), 5, k=7,
+                                   op="unit_test", shards=8)
+        assert out == (5, 7)
+        assert T._HISTS[hist_key].as_dict()["count"] == 1
+
+    def test_transient_failure_retried(self, _no_fault_hook):
+        PAR = _no_fault_hook
+        calls = []
+
+        def flaky(x):
+            calls.append(x)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return x * 2
+
+        assert PAR.guarded_dispatch(flaky, 21, op="unit_test") == 42
+        assert len(calls) == 3
+
+    def test_exhaustion_raises_shard_loss(self, _no_fault_hook,
+                                          monkeypatch):
+        PAR = _no_fault_hook
+        monkeypatch.setenv("QT_EXCHANGE_RETRIES", "2")
+
+        def always_fails(_x):
+            raise RuntimeError("dead link")
+
+        with pytest.raises(PAR.ShardLossError, match="after 2 attempts"):
+            PAR.guarded_dispatch(always_fails, 1, op="unit_test")
+
+    def test_injected_shard_loss_raises_immediately(self, _no_fault_hook):
+        PAR = _no_fault_hook
+        PAR.EXCHANGE_FAULT_HOOK[0] = lambda op: "shard_loss"
+        with pytest.raises(PAR.ShardLossError, match="injected shard loss"):
+            PAR.guarded_dispatch(lambda x: x, 1, op="unit_test")
+
+    def test_injected_stall_absorbed_and_counted(self, _no_fault_hook):
+        PAR = _no_fault_hook
+        from quest_tpu import telemetry as T
+
+        faults = iter(["stall"])
+        PAR.EXCHANGE_FAULT_HOOK[0] = lambda op: next(faults, None)
+        before = T.counter_value("exchange_timeouts_total", op="unit_test")
+        assert PAR.guarded_dispatch(lambda x: x + 1, 1, op="unit_test") == 2
+        after = T.counter_value("exchange_timeouts_total", op="unit_test")
+        assert after == before + 1
+
+    def test_deadline_overrun_counted_but_result_kept(self, _no_fault_hook,
+                                                      monkeypatch):
+        PAR = _no_fault_hook
+        from quest_tpu import telemetry as T
+
+        monkeypatch.setenv("QT_EXCHANGE_DEADLINE_S", "1e-9")  # all late
+        before = T.counter_value("exchange_timeouts_total", op="unit_test")
+        assert PAR.guarded_dispatch(lambda x: x, 9, op="unit_test") == 9
+        after = T.counter_value("exchange_timeouts_total", op="unit_test")
+        assert after == before + 1
+
+
+class TestElasticContracts:
+    """Fast unit contracts of the elastic restore path (the full
+    save/resume + failover cycles live in tests/test_elastic.py, run by
+    make verify-elastic)."""
+
+    def test_validated_perm(self):
+        assert R._validated_perm(None, 4) is None
+        assert R._validated_perm([1, 0, 2, 3], 4) == (1, 0, 2, 3)
+        with pytest.raises(ValueError):
+            R._validated_perm([0, 0, 1, 2], 4)  # not a permutation
+        with pytest.raises(ValueError):
+            R._validated_perm([0, 1], 4)  # wrong length
+
+    def test_shrink_env_validates(self, env):
+        from quest_tpu import env as ENV
+
+        with pytest.raises(ValueError):
+            ENV.shrink_env(env, 3)  # not a power of two
+        with pytest.raises(ValueError):
+            ENV.shrink_env(env, 16)  # more devices than survive
+        e2 = ENV.shrink_env(env, 2)
+        assert e2.num_devices == 2
+        assert e2.seeds == env.seeds  # RNG streams belong to the run
+
 
 class TestBoundaries:
     def test_plan_checkpoint_boundaries(self):

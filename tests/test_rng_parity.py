@@ -1,0 +1,186 @@
+"""Documents the host measurement RNG's relationship to the reference's
+mt19937ar (VERDICT r5 item b).
+
+The README once claimed `QT_HOST_MEASURE=1` gives bitwise outcome-stream
+parity with a seeded reference run.  It does not, and these tests pin
+exactly why, against a minimal faithful mt19937ar implementation:
+
+1. SEEDING diverges: `rng.py` seeds ``np.random.MT19937(key_array)``,
+   which feeds the keys through numpy's SeedSequence hash — not the
+   reference's ``init_by_array`` (seedQuEST -> init_by_array,
+   QuEST_common.c:195-217) — so the same seeds produce a different
+   624-word generator state.
+2. The UNIFORM DRAW diverges: each host outcome consumes numpy's
+   ``random_sample`` — the two-output 53-bit ``genrand_res53``
+   construction — while the reference's generateMeasurementOutcome
+   (QuEST_common.c:168-183) draws ONE 32-bit output via
+   ``genrand_real1``.  Different value AND a different state advance per
+   draw, even from an identical generator state.
+
+What IS guaranteed (and pinned here): seeded host measurement streams
+are bit-reproducible against themselves.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import rng as qt_rng
+
+
+class MT19937ar:
+    """Minimal faithful port of the reference's mt19937ar.c (init_by_array
+    seeding, genrand_int32 tempering, genrand_real1 / genrand_res53)."""
+
+    def __init__(self):
+        self.mt = [0] * 624
+        self.mti = 625
+
+    def init_genrand(self, s):
+        self.mt[0] = s & 0xFFFFFFFF
+        for i in range(1, 624):
+            self.mt[i] = (1812433253
+                          * (self.mt[i - 1] ^ (self.mt[i - 1] >> 30))
+                          + i) & 0xFFFFFFFF
+        self.mti = 624
+
+    def init_by_array(self, key):
+        self.init_genrand(19650218)
+        i, j = 1, 0
+        for _ in range(max(624, len(key))):
+            self.mt[i] = ((self.mt[i]
+                           ^ ((self.mt[i - 1] ^ (self.mt[i - 1] >> 30))
+                              * 1664525))
+                          + key[j] + j) & 0xFFFFFFFF
+            i += 1
+            j += 1
+            if i >= 624:
+                self.mt[0] = self.mt[623]
+                i = 1
+            if j >= len(key):
+                j = 0
+        for _ in range(623):
+            self.mt[i] = ((self.mt[i]
+                           ^ ((self.mt[i - 1] ^ (self.mt[i - 1] >> 30))
+                              * 1566083941))
+                          - i) & 0xFFFFFFFF
+            i += 1
+            if i >= 624:
+                self.mt[0] = self.mt[623]
+                i = 1
+        self.mt[0] = 0x80000000
+
+    def genrand_int32(self):
+        if self.mti >= 624:
+            for k in range(624):
+                y = ((self.mt[k] & 0x80000000)
+                     | (self.mt[(k + 1) % 624] & 0x7FFFFFFF))
+                v = y >> 1
+                if y & 1:
+                    v ^= 0x9908B0DF
+                self.mt[k] = self.mt[(k + 397) % 624] ^ v
+            self.mti = 0
+        y = self.mt[self.mti]
+        self.mti += 1
+        y ^= y >> 11
+        y ^= (y << 7) & 0x9D2C5680
+        y ^= (y << 15) & 0xEFC60000
+        y ^= y >> 18
+        return y & 0xFFFFFFFF
+
+    def genrand_real1(self):
+        # the reference's generateMeasurementOutcome draw
+        return self.genrand_int32() * (1.0 / 4294967295.0)
+
+    def genrand_res53(self):
+        a = self.genrand_int32() >> 5
+        b = self.genrand_int32() >> 6
+        return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0)
+
+
+SEEDS = [1234, 5678]
+
+
+def _state_key(rs: np.random.RandomState) -> np.ndarray:
+    return rs.get_state()[1]
+
+
+class TestSeedingDivergence:
+    def test_numpy_key_array_seeding_is_not_init_by_array(self):
+        """The generator STATE after quest_tpu's seeding differs from the
+        reference's init_by_array over the same keys — numpy hashes the
+        key array through SeedSequence instead."""
+        ref = MT19937ar()
+        ref.init_by_array(SEEDS)
+        ours = np.random.RandomState(
+            np.random.MT19937(np.array(SEEDS, dtype=np.uint32)))
+        assert not np.array_equal(
+            _state_key(ours), np.array(ref.mt, dtype=np.uint32))
+
+    def test_first_host_draw_differs_from_reference(self):
+        """End to end: seedQuEST's host stream does not reproduce the
+        reference's first seeded measurement draw."""
+        ref = MT19937ar()
+        ref.init_by_array(SEEDS)
+        qt_rng.GLOBAL_RNG.seed(SEEDS)
+        assert qt_rng.GLOBAL_RNG.uniform() != ref.genrand_real1()
+
+
+class TestDrawDivergence:
+    def _numpy_from_ref_state(self, ref: MT19937ar) -> np.random.RandomState:
+        rs = np.random.RandomState(np.random.MT19937(0))
+        rs.set_state(("MT19937", np.array(ref.mt, dtype=np.uint32),
+                      ref.mti, 0, 0.0))
+        return rs
+
+    def test_random_sample_is_genrand_res53(self):
+        """From an IDENTICAL generator state, numpy's random_sample is
+        bitwise mt19937ar's genrand_res53 (two 32-bit outputs per
+        draw)..."""
+        ref = MT19937ar()
+        ref.init_by_array(SEEDS)
+        rs = self._numpy_from_ref_state(ref)
+        for _ in range(8):
+            assert rs.random_sample() == ref.genrand_res53()
+
+    def test_random_sample_is_not_genrand_real1(self):
+        """...and genrand_res53 is NOT genrand_real1, the single-output
+        draw the reference's generateMeasurementOutcome uses — so even
+        a hypothetical init_by_array-seeded host stream would diverge on
+        the first draw."""
+        ref = MT19937ar()
+        ref.init_by_array(SEEDS)
+        rs = self._numpy_from_ref_state(ref)
+        assert rs.random_sample() != ref.genrand_real1()
+
+
+class TestSelfReproducibility:
+    def test_host_measurement_stream_reproducible(self, env, monkeypatch):
+        """The guarantee the docs DO make: same seeds -> same host
+        measurement outcome stream."""
+        monkeypatch.setenv("QT_HOST_MEASURE", "1")
+
+        def stream():
+            qt.seedQuEST(env, [11, 22])
+            q = qt.createQureg(3, env)
+            outs = []
+            for _ in range(12):
+                qt.hadamard(q, 0)
+                outs.append(qt.measure(q, 0))
+            qt.destroyQureg(q, env)
+            return outs
+
+        assert stream() == stream()
+
+    def test_uniform_matches_numpy_stream(self):
+        """The host draw is exactly numpy's random_sample over the seeded
+        RandomState — the anchor for the divergence statements above."""
+        qt_rng.GLOBAL_RNG.seed(SEEDS)
+        mirror = np.random.RandomState(
+            np.random.MT19937(np.array(SEEDS, dtype=np.uint32)))
+        draws = [qt_rng.GLOBAL_RNG.uniform() for _ in range(8)]
+        assert draws == list(mirror.random_sample(8))
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
