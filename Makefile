@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults verify-telemetry verify-elastic bench docs clean
+.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch bench docs clean
 
 all: native
 
@@ -48,6 +48,14 @@ verify-elastic:
 verify-telemetry:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	python scripts/bench_telemetry.py
+
+# Batched execution (docs/design.md §20): register banks, ensemble
+# scheduling, trajectory sampling — the bit-parity/retrace/convergence
+# suite plus the batched-vs-looped throughput guard (>= 4x circuits/sec
+# at batch 16).
+verify-batch:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_batch.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu python scripts/bench_batch.py
 
 bench: native
 	python bench.py

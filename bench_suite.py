@@ -446,8 +446,33 @@ def config8():
         telemetry.configure(prev_mode)
 
 
+def config9():
+    """Batched-vs-looped ensemble A/B (round-11): B copies of a depth-4
+    layered ansatz as one (B, 2, 2^n) BatchedQureg bank against B
+    independent scalar runs, B in {1, 4, 16, 64}.  The per-B timing rows
+    (circuits/sec both arms, per-circuit latency, speedup) land in the
+    standard BENCH artifact; the >= 4x-at-B=16 acceptance gate is the
+    separate scripts/bench_batch.py guard (make verify-batch)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_batch
+
+    n = 10 if CPU else 20
+    t0 = time.perf_counter()
+    _env, rows = bench_batch.run_ab(n, depth=4, batches=[1, 4, 16, 64],
+                                    reps=3)
+    _set_compile(0.0)  # warm-up folded into each row's own best-of loop
+    at16 = next(r for r in rows if r["batch"] == 16)
+    _emit(9, f"{n}q batched-vs-looped ensemble throughput",
+          at16["batched_circuits_per_sec"], "circuits_per_sec",
+          round(time.perf_counter() - t0, 3),
+          {"speedup_at_16": at16["speedup"],
+           "per_circuit_ms_at_16": at16["batched_per_circuit_ms"],
+           "results": rows})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
+           6: config6, 7: config7, 8: config8, 9: config9}
 
 
 def main():

@@ -52,6 +52,16 @@ from .resilience import (
     NumericalHealthError,
     degradation_report,
 )
+from .batch import (
+    BatchedQureg,
+    EnsembleScheduler,
+    createBatchedQureg,
+    applyBatchedUnitary,
+    measureBatched,
+    calcExpecPauliSumBatched,
+    run_trajectories,
+    run_trajectories as runTrajectories,
+)
 from .debug import (
     initStateOfSingleQubit,
     initStateFromSingleFile,

@@ -54,6 +54,28 @@ _K_SWAP = _telemetry.counter_key("dispatch_total", family="swap")
 # bitEncoding (QuEST.h:269)
 UNSIGNED, TWOS_COMPLEMENT = 0, 1
 
+
+def _bw(qureg) -> int:
+    """Telemetry weight of one dispatch on this register: a BatchedQureg
+    applies every gate to all B bank elements, so dispatch_total counts
+    B logical gate applications (batch.py; telemetry truthfulness under
+    batching)."""
+    return int(getattr(qureg, "batch_size", 0) or 0) or 1
+
+
+def _guard_batched_eager(qureg, what: str) -> None:
+    """A BatchedQureg's (B, 2, 2^n) bank only flows through the fused
+    drain (vmapped) and the batch helpers — the eager scalar kernels
+    would silently misread the leading batch axis, so falling out of the
+    capture path is a structured error, never a wrong answer."""
+    if getattr(qureg, "batch_size", 0):
+        raise V.QuESTError(
+            f"{what}: the operation fell out of the fused capture path, "
+            "and a BatchedQureg bank has no eager scalar dispatch — keep "
+            "gates within fusion limits (<= "
+            f"{_fusion.FUSION_MAX_GATE_QUBITS} qubits, shard-local on a "
+            "mesh) or use the quest_tpu.batch helpers")
+
 # ---------------------------------------------------------------------------
 # Environment (QuEST.h:1851-1939)
 # ---------------------------------------------------------------------------
@@ -485,6 +507,7 @@ def _dispatch_matrix(qureg, stacked, targets, controls, control_states):
     n = _sv_n(qureg)
     # size of the amplitude-sharding axis, NOT total devices: meshes may
     # carry extra axes (e.g. the (dp, amps) training mesh)
+    _guard_batched_eager(qureg, "_dispatch_matrix")
     ndev = PAR.amp_axis_size(env.mesh) if env.mesh is not None else 1
     if ndev > 1 and (1 << n) > ndev and PAR.explicit_dist_enabled():
         nloc = n - PAR.num_shard_bits(env.mesh)
@@ -574,7 +597,7 @@ def _apply_unitary(qureg, matrix, targets, controls=(), control_states=()):
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
-    _telemetry.inc_key(_K_UNITARY)
+    _telemetry.inc_key(_K_UNITARY, _bw(qureg))
     stacked = CX.soa(matrix)
     if _fusion.capture_unitary(qureg, stacked, targets, controls, control_states):
         return
@@ -599,10 +622,11 @@ def _apply_diag(qureg, diag, targets, controls=(), control_states=()):
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     control_states = tuple(int(s) for s in control_states)
-    _telemetry.inc_key(_K_DIAG)
+    _telemetry.inc_key(_K_DIAG, _bw(qureg))
     stacked = CX.soa(diag)
     if _fusion.capture_diag(qureg, stacked, targets, controls, control_states):
         return
+    _guard_batched_eager(qureg, "_apply_diag")
     amps = qureg._amps_raw()  # drains any pending fusion first
     perm = qureg._perm
     qureg._set_amps_permuted(
@@ -859,9 +883,10 @@ def _apply_not(qureg, targets, controls, control_states=()):
     """NOTs are pure index-bit flips, position-independent — like
     _apply_diag they run at the physical positions of a live
     permutation."""
-    _telemetry.inc_key(_K_NOT)
+    _telemetry.inc_key(_K_NOT, _bw(qureg))
     if _fusion.capture_not(qureg, targets, controls, control_states):
         return
+    _guard_batched_eager(qureg, "_apply_not")
     amps = qureg._amps_raw()  # drains any pending fusion first
     perm = qureg._perm
     qureg._set_amps_permuted(
@@ -905,7 +930,7 @@ def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
     QuEST_cpu_distributed.c:1397-1436); canonical order rematerializes on
     the next state read."""
     V.validate_unique_targets(qureg, qubit1, qubit2, "swapGate")
-    _telemetry.inc_key(_K_SWAP)
+    _telemetry.inc_key(_K_SWAP, _bw(qureg))
     if _fusion.capture_unitary(qureg, _SWAP_SOA, (qubit1, qubit2)):
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
         return
@@ -925,6 +950,7 @@ def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
         qureg._set_amps_permuted(amps, tuple(perm))
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
         return
+    _guard_batched_eager(qureg, "swapGate")
     qureg.amps = K.swap_qubit_amps(qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
     if qureg.is_density_matrix:
         sh = _shift(qureg)
@@ -962,7 +988,8 @@ def multiControlledMultiRotateZ(qureg, controlQubits, targetQubits, angle) -> No
 def _apply_parity_phase(qureg, angle, qubits, controls, conj=False):
     # parity phases are index-derived (elementwise): physical positions
     # of the live permutation, no rematerialization
-    _telemetry.inc_key(_K_PARITY)
+    _telemetry.inc_key(_K_PARITY, _bw(qureg))
+    _guard_batched_eager(qureg, "_apply_parity_phase")
     a = -angle if conj else angle
     amps = qureg._amps_raw()  # drains any pending fusion first
     perm = qureg._perm

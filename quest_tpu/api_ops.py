@@ -125,6 +125,11 @@ def measureWithStats(qureg: Qureg, measureQubit: int):
     collapse (ops/measurement.py).  QT_HOST_MEASURE=1 (or strict parity
     mode) restores the reference's host-MT sampling stream
     (calcProb -> generateMeasurementOutcome -> collapse)."""
+    if getattr(qureg, "batch_size", 0):
+        raise V.QuESTError(
+            "measureWithStats: the register is a BatchedQureg bank — "
+            "use quest_tpu.batch.measureBatched, which draws from the "
+            "per-element key streams")
     V.validate_target(qureg, measureQubit, "measureWithStats")
     _telemetry.inc("measurement_shots_total")
     from .ops import measurement as M
@@ -156,6 +161,11 @@ def measureSequence(qureg: Qureg, qubits: Sequence[int]):
     falling back to a loop of host-path measureWithStats."""
     from .ops import measurement as M
 
+    if getattr(qureg, "batch_size", 0):
+        raise V.QuESTError(
+            "measureSequence: the register is a BatchedQureg bank — "
+            "use quest_tpu.batch.measureBatched, which draws from the "
+            "per-element key streams")
     qubits = [int(q) for q in qubits]
     for q in qubits:
         V.validate_target(qureg, q, "measureSequence")

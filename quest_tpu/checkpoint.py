@@ -44,6 +44,9 @@ def _qureg_meta(qureg: Qureg) -> dict:
         "dtype": str(np.dtype(qureg.dtype)),
         "precision": precision.get_precision(),
         "mesh_shards": qureg.num_chunks,
+        # 0 = scalar register; B >= 1 = a BatchedQureg bank of B elements
+        # (batch.py) whose payload is (B, 2, 2^n)
+        "batch": int(getattr(qureg, "batch_size", 0) or 0),
     }
 
 
@@ -83,7 +86,15 @@ def _qureg_from_meta(meta: dict, env: QuESTEnv) -> Qureg:
             f"{precision.get_precision()}); call set_precision to match "
             "before loading"
         )
-    q = Qureg(meta["num_qubits_represented"], env, meta["is_density_matrix"])
+    batch = int(meta.get("batch", 0) or 0)
+    if batch:
+        from .batch import BatchedQureg
+
+        q = BatchedQureg(meta["num_qubits_represented"], env, batch,
+                         is_density_matrix=meta["is_density_matrix"])
+    else:
+        q = Qureg(meta["num_qubits_represented"], env,
+                  meta["is_density_matrix"])
     if q.num_amps_total < env.num_devices:
         raise QuESTError(
             "loadQureg: the mesh has grown past the register's shardable "
@@ -103,9 +114,9 @@ def _restore_amps(path: str, q: Qureg):
     from . import resilience
 
     ckpt = _checkpointer()
-    target = jax.ShapeDtypeStruct(
-        (2, q.num_amps_total), q.dtype, sharding=q.sharding()
-    )
+    batch = int(getattr(q, "batch_size", 0) or 0)
+    shape = (batch, 2, q.num_amps_total) if batch else (2, q.num_amps_total)
+    target = jax.ShapeDtypeStruct(shape, q.dtype, sharding=q.sharding())
     restored = resilience.retry_io(
         ckpt.restore, os.path.join(path, _AMPS_NAME), {"amps": target},
         what="loadQureg(amps)")

@@ -219,6 +219,19 @@ def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
     return tuple(program), tuple(arrays), final_perm
 
 
+def _items_for_element(items, b: int):
+    """Item list for batch element ``b``: per-element matrices — an extra
+    leading batch axis on ``Gate.mat`` — are sliced down; shared matrices
+    and channels pass through unchanged."""
+    out = []
+    for it in items:
+        if isinstance(it, ChannelItem) or getattr(it.mat, "ndim", 0) != 4:
+            out.append(it)
+        else:
+            out.append(C.Gate(it.targets, it.mat[b]))
+    return out
+
+
 def _run(qureg, items) -> None:
     """Plan with the CONCRETE gate matrices (so controlled gates Schmidt-
     decompose to their true rank), then execute the whole item sequence —
@@ -228,10 +241,22 @@ def _run(qureg, items) -> None:
     so repeated drains of the same shape (e.g. angle sweeps, noise-layer
     reps) never recompile and cost a single host->device round-trip.
     Fully-concrete item lists also cache the MATERIALIZED plan (pass
-    matrices), so repeated identical drains skip host planning entirely."""
+    matrices), so repeated identical drains skip host planning entirely.
+
+    On a BatchedQureg (batch.py) the same program runs vmapped over the
+    leading batch axis of the (B, 2, 2^n) amplitude bank — the plan, the
+    live logical->physical permutation, and the window remap schedule are
+    SHARED across the batch because every element runs the same gate
+    stream.  Per-element gate matrices (a (B, 2, s, s) ``Gate.mat``) are
+    planned per element against a shared skeleton and the pass arrays
+    enter the program with their own batch axis (vmap in_axes 0)."""
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
+    bsz = int(getattr(qureg, "batch_size", 0) or 0)
+    mats_batched = bool(bsz) and any(
+        not isinstance(it, ChannelItem) and getattr(it.mat, "ndim", 0) == 4
+        for it in items)
     from .ops import fused as _fusedmod
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
     perm0 = qureg._perm if nsh else None
@@ -243,7 +268,10 @@ def _run(qureg, items) -> None:
     else:
         _telemetry.inc("fusion_plan_cache_misses_total")
         with _telemetry.span("fusion.plan", items=len(items)):
-            if nsh:
+            if mats_batched:
+                program, arrays, final_perm = _plan_batched_items(
+                    items, bsz, n, nloc, nsh, perm0, sweep_ok)
+            elif nsh:
                 program, arrays, final_perm = _split_items_sharded(
                     items, n, nloc, perm0, sweep_ok)
             else:
@@ -257,6 +285,7 @@ def _run(qureg, items) -> None:
         _telemetry.inc("fusion_windows_total",
                        sum(1 for p in program if p[0] == "plan"))
         if nsh:
+            bw = max(bsz, 1)  # each batch element exchanges its own amps
             # window-remap ICI accounting at dispatch time: each
             # ("remap", sigma) part's per-shard exchange classes and
             # bytes come from the same cost model the tests pin
@@ -273,8 +302,9 @@ def _run(qureg, items) -> None:
                 cnt = len(mixed) + (1 if mesh_tau is not None else 0)
                 if cnt:
                     _telemetry.record_exchange(
-                        "window_remap", cnt,
-                        C.remap_exchange_bytes(sigma, n, nloc, itemsize),
+                        "window_remap", cnt * bw,
+                        bw * C.remap_exchange_bytes(sigma, n, nloc,
+                                                    itemsize),
                         chunks=ck)
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
@@ -286,7 +316,8 @@ def _run(qureg, items) -> None:
         exchange_key = None
     runner = _plan_runner(nloc, program,
                           qureg.env.mesh if nsh else None,
-                          _fused.matmul_precision_name(), exchange_key)
+                          _fused.matmul_precision_name(), exchange_key,
+                          (2 if mats_batched else 1) if bsz else 0)
     # bypass the amps property (which would re-enter drain); the live
     # permutation the windowed plan leaves behind is carried on the
     # register — the next drain starts from it, the next READ
@@ -307,9 +338,44 @@ def _run(qureg, items) -> None:
             qureg._perm = None
 
 
+def _plan_batched_items(items, bsz: int, n: int, nloc: int, nsh: int,
+                        perm0, sweep_ok: bool):
+    """Plan a drain whose items carry PER-ELEMENT matrices: each batch
+    element is planned independently (the decomposition of a controlled
+    gate is value-dependent) and all elements must produce the SAME
+    program skeleton — the compiled executor is shared across the batch,
+    only the pass arrays differ.  Returns (program, arrays, final_perm)
+    with each pass array stacked to a leading (B, ...) batch axis."""
+    program = None
+    final_perm = None
+    per_elem = []
+    for b in range(bsz):
+        eit = _items_for_element(items, b)
+        if nsh:
+            pb, ab, fp = _split_items_sharded(eit, n, nloc, perm0, sweep_ok)
+        else:
+            (pb, ab), fp = _split_items(eit, nloc, sweep_ok), None
+        if b == 0:
+            program, final_perm = pb, fp
+        elif pb != program or fp != final_perm:
+            from .validation import QuESTError
+
+            raise QuESTError(
+                "batched drain: batch element %d's gate stream plans to a "
+                "different program skeleton than element 0 (value-dependent "
+                "decomposition, e.g. a controlled gate of different Schmidt "
+                "rank) — such submissions cannot share one batched program; "
+                "run them in separate ensemble groups" % b)
+        per_elem.append(ab)
+    arrays = tuple(
+        np.stack([np.asarray(per_elem[b][j]) for b in range(bsz)])
+        for j in range(len(per_elem[0])))
+    return program, arrays, final_perm
+
+
 @lru_cache(maxsize=256)
 def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
-                 exchange_key: str = None):
+                 exchange_key: str = None, batch: int = 0):
     """Jitted whole-program executor over ("plan", skeleton, n_arrays) /
     ("chan", kind, t, b) parts in order.  For a sharded register the
     program (all items shard-local by capture policy) runs inside ONE
@@ -318,7 +384,14 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
     parts bake the pipelined-exchange chunk count in at trace time, so
     the compiled executor must be keyed on the QT_EXCHANGE_CHUNKS
     override (a stale cache entry would silently keep the old chunk
-    schedule)."""
+    schedule).
+
+    ``batch``: 0 = scalar register; 1 = (B, 2, 2^n) register bank, pass
+    arrays shared across the batch; 2 = bank + per-element pass arrays
+    (leading (B, ...) axis, vmap in_axes 0).  The batched program is the
+    SAME ``_apply`` body vmapped over the batch axis — on a mesh the
+    vmap sits INSIDE the shard_map kernel (batch-outer/amps-inner:
+    collectives move every element's shard slice in one exchange)."""
     # this body runs only on an lru_cache MISS: each execution is a new
     # compiled-executor shape — the drain's retrace count
     _telemetry.inc("fusion_retrace_total")
@@ -329,37 +402,48 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
 
         _ndev = PAR.amp_axis_size(mesh)
 
+    def _apply_part(part, amps, arrays, probs, ai, pi):
+        if part[0] == "plan":
+            _, skeleton, na = part
+            amps = C.execute_plan(
+                amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
+                nloc, precision=precision)
+        elif part[0] == "remap":
+            # ONE batched window relocalization (mixed half-shard
+            # swaps + per-shard axis permutation + composed shard
+            # ppermute) — only emitted inside the mesh path's
+            # shard_map body
+            from .parallel import dist as PAR
+            amps = PAR._remap_in_shard(
+                amps.reshape(2, -1), part[1], nloc, _ndev
+            ).reshape(amps.shape)
+        elif part[0] == "chansweep":
+            entries = part[1]
+            from .ops import fused as _fusedmod
+            amps = _fusedmod.apply_pair_channel_sweep(
+                amps.reshape(2, -1), entries,
+                probs[pi:pi + len(entries)],
+                num_bits=nloc).reshape(amps.shape)
+        else:
+            _, kind, t, b = part
+            amps = _density.apply_pair_channel(
+                amps, kind, probs[pi], nn=nloc, t=t, b=b)
+        return amps
+
+    def _advance(part, ai, pi):
+        if part[0] == "plan":
+            return ai + part[2], pi
+        if part[0] == "chansweep":
+            return ai, pi + len(part[1])
+        if part[0] == "remap":
+            return ai, pi
+        return ai, pi + 1
+
     def _apply(amps, arrays, probs):
         ai = pi = 0
         for part in program:
-            if part[0] == "plan":
-                _, skeleton, na = part
-                amps = C.execute_plan(
-                    amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
-                    nloc, precision=precision)
-                ai += na
-            elif part[0] == "remap":
-                # ONE batched window relocalization (mixed half-shard
-                # swaps + per-shard axis permutation + composed shard
-                # ppermute) — only emitted inside the mesh path's
-                # shard_map body
-                from .parallel import dist as PAR
-                amps = PAR._remap_in_shard(
-                    amps.reshape(2, -1), part[1], nloc, _ndev
-                ).reshape(amps.shape)
-            elif part[0] == "chansweep":
-                entries = part[1]
-                from .ops import fused as _fusedmod
-                amps = _fusedmod.apply_pair_channel_sweep(
-                    amps.reshape(2, -1), entries,
-                    probs[pi:pi + len(entries)],
-                    num_bits=nloc).reshape(amps.shape)
-                pi += len(entries)
-            else:
-                _, kind, t, b = part
-                amps = _density.apply_pair_channel(
-                    amps, kind, probs[pi], nn=nloc, t=t, b=b)
-                pi += 1
+            amps = _apply_part(part, amps, arrays, probs, ai, pi)
+            ai, pi = _advance(part, ai, pi)
             # without this barrier XLA:TPU's memory assignment keeps every
             # part's temporaries live to the end of the program (measured:
             # +1.25 GiB PER CHANNEL at 13q rho -> 21 GiB OOM; flat 1.75 GiB
@@ -367,21 +451,39 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
             amps = jax.lax.optimization_barrier(amps)
         return amps
 
+    if batch:
+        def _apply_fn(amps, arrays, probs):
+            # vmap part by part: optimization_barrier has no batching rule,
+            # and keeping it between (rather than inside) the vmapped parts
+            # preserves the same per-part liveness cut for the whole bank
+            ai = pi = 0
+            for part in program:
+                step = partial(_apply_part, part, ai=ai, pi=pi)
+                amps = jax.vmap(
+                    step, in_axes=(0, 0 if batch == 2 else None, None)
+                )(amps, arrays, probs)
+                ai, pi = _advance(part, ai, pi)
+                amps = jax.lax.optimization_barrier(amps)
+            return amps
+    else:
+        _apply_fn = _apply
+
     @partial(jax.jit, donate_argnums=0)
     def run(amps, arrays, probs):
         if mesh is None:
-            return _apply(amps, arrays, probs)
+            return _apply_fn(amps, arrays, probs)
         from jax.sharding import PartitionSpec as P
 
         from .env import AMP_AXIS, shard_map
 
         def kernel(local, *arrs):
-            return _apply(local, arrs[:len(arrays)], arrs[len(arrays):])
+            return _apply_fn(local, arrs[:len(arrays)], arrs[len(arrays):])
 
+        amp_spec = P(None, None, AMP_AXIS) if batch else P(None, AMP_AXIS)
         return shard_map(
             kernel, mesh=mesh,
-            in_specs=(P(None, AMP_AXIS),) + (P(),) * (len(arrays) + len(probs)),
-            out_specs=P(None, AMP_AXIS),
+            in_specs=(amp_spec,) + (P(),) * (len(arrays) + len(probs)),
+            out_specs=amp_spec,
             check_vma=False,  # pallas_call inside shard_map has no vma info
         )(amps, *arrays, *probs)
 

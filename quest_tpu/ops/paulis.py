@@ -646,10 +646,12 @@ def trotter_scan(amps, codes_seq, angles, *, num_qubits: int,
     elementwise combine; density matrices add the conjugated bra twin at
     -theta) — ~8x the throughput of the rotate/phase/unrotate window
     body at 24q.  Registers beyond _DIRECT_MAX_N state bits (where the
-    row-gather iota would overflow int32) and the SHARDED scan
-    (parallel.dist.trotter_scan_sharded — a traced XOR of mesh bits
-    cannot ride a static ppermute) keep the rotation-conjugation body;
-    mesh-sweep parity tests pin the two forms equal."""
+    row-gather iota would overflow int32) keep the rotation-conjugation
+    body; the SHARDED scan (parallel.dist.trotter_scan_sharded) carries
+    the same direct body with the mesh-bit part of the traced flip mask
+    riding a lax.switch over the 2^r static XOR ppermutes
+    (dist._mesh_flip_gather); mesh-sweep parity tests pin the forms
+    equal."""
     n, nq = num_qubits, rep_qubits
     dt = amps.dtype
     if n > _DIRECT_MAX_N:

@@ -320,8 +320,16 @@ def _health_fn():
     if fn is None:
         @jax.jit
         def fn(amps):
-            sq = amps[0] * amps[0] + amps[1] * amps[1]
-            norm = jnp.sum(sq)
+            if amps.ndim == 3:
+                # a BatchedQureg bank: per-element norms, report the one
+                # FARTHEST from 1 so the watchdog's |norm - 1| verdict
+                # covers every element of the bank
+                sq = amps[:, 0] * amps[:, 0] + amps[:, 1] * amps[:, 1]
+                norms = jnp.sum(sq, axis=1)
+                norm = norms[jnp.argmax(jnp.abs(norms - 1.0))]
+            else:
+                sq = amps[0] * amps[0] + amps[1] * amps[1]
+                norm = jnp.sum(sq)
             finite = jnp.all(jnp.isfinite(amps))
             return jnp.stack([norm, finite.astype(amps.dtype)])
 
@@ -418,6 +426,10 @@ def save_generation(qureg, ckpt_dir: str, cursor: int, *,
         "fingerprint": fingerprint,
         "rng": _rng.GLOBAL_RNG.get_state(),
         "measure_keys": M.KEYS.get_state(),
+        # a BatchedQureg's PER-ELEMENT measurement key bank (batch.py) —
+        # None for scalar registers
+        "batch_keys": qureg.key_state()
+        if hasattr(qureg, "key_state") else None,
         # the writing mesh's shard count: informational for the elastic
         # restore path (load_latest reshards onto whatever mesh loads it;
         # strict_mesh=True refuses any difference)
@@ -534,6 +546,8 @@ def _load_generation(ckpt_dir: str, cursor: int, env, *,
     amps = CKPT._restore_amps(gen, q)
     perm = _validated_perm(meta.get("perm"), q.num_qubits_in_state_vec)
     q._set_amps_permuted(amps, perm)
+    if meta.get("batch_keys") is not None and hasattr(q, "set_key_state"):
+        q.set_key_state(meta["batch_keys"])
     return q, meta
 
 
@@ -791,8 +805,22 @@ def _restore_into(qureg, restored, meta) -> None:
             f"{restored.is_density_matrix}) does not match the target "
             f"register ({qureg.num_qubits_represented} qubits, density="
             f"{qureg.is_density_matrix})")
+    rb = int(getattr(restored, "batch_size", 0) or 0)
+    qb = int(getattr(qureg, "batch_size", 0) or 0)
+    if rb != qb:
+        raise QuESTError(
+            "run_resumable: checkpoint batch mismatch — the generation "
+            + (f"holds a bank of {rb} elements" if rb
+               else "holds a scalar register")
+            + " but the target register "
+            + (f"is a bank of {qb} elements" if qb else "is scalar")
+            + "; a batched checkpoint only restores into a BatchedQureg "
+            "of the same batch size")
     qureg.bind_checkpoint_state(restored._amps, restored._perm,
                                 restored.dtype)
+    if meta.get("batch_keys") is not None \
+            and hasattr(qureg, "set_key_state"):
+        qureg.set_key_state(meta["batch_keys"])
     if meta.get("rng") is not None:
         _rng.GLOBAL_RNG.set_state(meta["rng"])
     if meta.get("measure_keys") is not None:

@@ -1,0 +1,133 @@
+"""Batched-vs-looped A/B (ISSUE round-11 acceptance): running B copies
+of one circuit as a single (B, 2, 2^n) BatchedQureg bank must beat B
+independent scalar runs by >= 4x circuits/sec at B=16 on the dryrun
+mesh.
+
+The workload is a depth-D layered ansatz (per-qubit 1q unitaries + a
+CNOT ladder) issued through the public camelCase API, so both arms pay
+the same capture path; the batched arm drains ONE vmapped window
+program where the looped arm drains B scalar programs.  Both arms warm
+their compile caches before timing — the measured quantity is steady
+state throughput (circuits/sec) and per-circuit latency, which is what
+an ensemble/trajectory workload sees.
+
+Usage: python scripts/bench_batch.py [--n 10] [--depth 4] [--reps 3]
+       [--batches 1,4,16,64] [--speedup-at 16] [--budget 4.0]
+       [--no-check]
+Exits non-zero when the speedup budget fails (unless --no-check).
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+
+
+def _arg(flag, default, cast=int):
+    return cast(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def _circuit(q, n, depth, mats):
+    for d in range(depth):
+        for t in range(n):
+            qt.unitary(q, t, mats[d * n + t])
+        for t in range(n - 1):
+            qt.controlledNot(q, t, t + 1)
+
+
+def run_ab(n, depth, batches, reps):
+    env = qt.createQuESTEnv()
+    rng = np.random.default_rng(23)
+    mats = []
+    for _ in range(depth * max(1, n)):
+        g = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+        u, _r = np.linalg.qr(g)
+        mats.append(u)
+
+    def looped(B):
+        for _ in range(B):
+            q = qt.createQureg(n, env)
+            with qt.gateFusion(q):
+                _circuit(q, n, depth, mats)
+            q.amps.block_until_ready()
+
+    def batched(B):
+        bq = qt.createBatchedQureg(n, env, B)
+        _circuit(bq, n, depth, mats)
+        bq.amps.block_until_ready()
+
+    def best_of(fn, B):
+        fn(B)  # warm the plan + executor caches for this batch shape
+        best = math.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(B)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    rows = []
+    for B in batches:
+        loop_s = best_of(looped, B)
+        bank_s = best_of(batched, B)
+        rows.append({
+            "batch": B,
+            "looped_seconds": round(loop_s, 5),
+            "batched_seconds": round(bank_s, 5),
+            "looped_circuits_per_sec": round(B / loop_s, 2),
+            "batched_circuits_per_sec": round(B / bank_s, 2),
+            "batched_per_circuit_ms": round(1e3 * bank_s / B, 3),
+            "speedup": round(loop_s / bank_s, 2),
+        })
+    return env, rows
+
+
+def main():
+    n = _arg("--n", 10)
+    depth = _arg("--depth", 4)
+    reps = _arg("--reps", 3)
+    batches = _arg("--batches", [1, 4, 16, 64],
+                   lambda s: [int(x) for x in s.split(",")])
+    speedup_at = _arg("--speedup-at", 16)
+    budget = _arg("--budget", 4.0, float)
+
+    env, rows = run_ab(n, depth, batches, reps)
+    gate_count = depth * (2 * n - 1)
+    rec = {
+        "bench": "batched_vs_looped",
+        "n": n,
+        "depth": depth,
+        "gates_per_circuit": gate_count,
+        "backend": jax.default_backend(),
+        "devices": env.num_devices,
+        "results": rows,
+    }
+    print(json.dumps(rec), flush=True)
+    if "--no-check" in sys.argv:
+        return 0
+    at = next((r for r in rows if r["batch"] == speedup_at), None)
+    if at is None:
+        print(f"FAIL: batch {speedup_at} not in the sweep", file=sys.stderr)
+        return 1
+    if at["speedup"] < budget:
+        print(f"FAIL: batched speedup {at['speedup']:.2f}x at batch "
+              f"{speedup_at} is below the {budget:.1f}x budget",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,389 @@
+"""Batched registers (quest_tpu/batch.py): bit-parity with independent
+runs, ensemble scheduling retrace bounds, trajectory-vs-density
+convergence, and checkpoint/resume of register banks.
+
+The batching contract is EXACT equality, not tolerance: a (B, 2, 2^n)
+bank run through the vmapped fusion drain must produce bit-identical
+amplitudes — and, with the per-element key bank, bit-identical
+measurement outcome streams — to B independent scalar runs (including on
+the 8-device dryrun mesh and across a checkpoint/resume cycle)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+import quest_tpu.circuit as C
+from quest_tpu import resilience as R
+from quest_tpu import telemetry as T
+from quest_tpu.ops import measurement as M
+from quest_tpu.validation import QuESTError
+
+NQ = 6
+NB = 4
+
+
+def _random_unitary(rng, k=1):
+    g = rng.standard_normal((1 << k, 1 << k)) \
+        + 1j * rng.standard_normal((1 << k, 1 << k))
+    u, _ = np.linalg.qr(g)
+    return u
+
+
+def _apply_circuit(q, depth=2):
+    """A fixed mixed circuit touching low, middle and mesh-coordinate
+    qubits (the top bits shard on the 8-device mesh)."""
+    for d in range(depth):
+        for t in range(NQ):
+            qt.hadamard(q, t)
+        qt.controlledNot(q, NQ - 1, 0)
+        qt.rotateZ(q, 2, 0.3 + 0.1 * d)
+        qt.swapGate(q, 1, NQ - 2)
+
+
+class TestBatchedVsLooped:
+    def test_gates_bit_parity(self, env):
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        _apply_circuit(bq)
+        bank = np.asarray(bq.amps)
+        assert bank.shape == (NB, 2, 1 << NQ)
+        for i in range(NB):
+            qi = qt.createQureg(NQ, env)
+            with qt.gateFusion(qi):
+                _apply_circuit(qi)
+            assert np.array_equal(bank[i], np.asarray(qi.amps))
+
+    def test_per_element_matrices_bit_parity(self, env):
+        rng = np.random.default_rng(1)
+        mats = [_random_unitary(rng) for _ in range(NB)]
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        qt.applyBatchedUnitary(bq, (1,), np.stack(mats))
+        qt.hadamard(bq, 0)  # shared gate mixed into the same drain
+        bank = np.asarray(bq.amps)
+        for i in range(NB):
+            qi = qt.createQureg(NQ, env)
+            with qt.gateFusion(qi):
+                qt.unitary(qi, 1, mats[i])
+                qt.hadamard(qi, 0)
+            assert np.array_equal(bank[i], np.asarray(qi.amps))
+
+    def test_density_bank_bit_parity(self, env):
+        rng = np.random.default_rng(2)
+        mats = [_random_unitary(rng) for _ in range(NB)]
+        nq = 3
+        bq = qt.createBatchedQureg(nq, env, NB, is_density_matrix=True)
+        qt.applyBatchedUnitary(bq, (1,), np.stack(mats))
+        qt.hadamard(bq, 0)
+        bank = np.asarray(bq.amps)
+        for i in range(NB):
+            qi = qt.createDensityQureg(nq, env)
+            with qt.gateFusion(qi):
+                qt.unitary(qi, 1, mats[i])
+                qt.hadamard(qi, 0)
+            assert np.array_equal(bank[i], np.asarray(qi.amps))
+
+    def test_seeded_measurement_bit_parity(self, env):
+        seeds = [[100 + i] for i in range(NB)]
+        bq = qt.createBatchedQureg(NQ, env, NB, seeds=seeds)
+        for t in range(NQ):
+            qt.hadamard(bq, t)
+        outs1, probs1 = qt.measureBatched(bq, 2)
+        outs2, _ = qt.measureBatched(bq, 0)
+        bank = np.asarray(bq.amps)
+        for i in range(NB):
+            qi = qt.createQureg(NQ, env)
+            M.KEYS.seed(seeds[i])
+            with qt.gateFusion(qi):
+                for t in range(NQ):
+                    qt.hadamard(qi, t)
+            o1, p1 = qt.measureWithStats(qi, 2)
+            o2, _ = qt.measureWithStats(qi, 0)
+            assert (o1, o2) == (int(outs1[i]), int(outs2[i]))
+            assert p1 == probs1[i]
+            assert np.array_equal(bank[i], np.asarray(qi.amps))
+
+    def test_expectation_bit_parity(self, env):
+        from quest_tpu.ops import paulis as OPS_P
+
+        rng = np.random.default_rng(3)
+        mats = [_random_unitary(rng) for _ in range(NB)]
+        codes = rng.integers(0, 4, size=(3, NQ)).astype(np.int32)
+        coeffs = np.linspace(0.5, 1.5, 3)
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        qt.applyBatchedUnitary(bq, (0,), np.stack(mats))
+        vals = qt.calcExpecPauliSumBatched(bq, codes, coeffs)
+        from quest_tpu import fusion as F
+
+        for i in range(NB):
+            qi = qt.createQureg(NQ, env)
+            qt.unitary(qi, 0, mats[i])
+            if F._shard_bits(qi):
+                from quest_tpu.parallel import dist as PAR
+
+                want = float(PAR.expec_pauli_sum_scan_sharded(
+                    qi.amps, codes, coeffs,
+                    mesh=env.mesh, num_qubits=NQ))
+            else:
+                want = float(OPS_P.expec_pauli_sum_scan(
+                    qi.amps, codes, coeffs, num_qubits=NQ))
+            assert vals[i] == want
+
+    def test_scalar_init_broadcasts(self, env):
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        qt.hadamard(bq, 0)
+        qt.initZeroState(bq)  # scalar (2, 2^n) write lifts to the bank
+        bank = np.asarray(bq.amps)
+        assert bank.shape == (NB, 2, 1 << NQ)
+        assert np.all(bank[:, 0, 0] == 1.0)
+        assert np.abs(bank).sum() == NB
+
+    def test_eager_fallback_is_structured_error(self, env):
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        with pytest.raises(QuESTError, match="BatchedQureg"):
+            qt.multiRotateZ(bq, [0, 1], 0.3)  # parity phase: no capture
+        with pytest.raises(QuESTError, match="measureBatched"):
+            qt.measure(bq, 0)
+
+
+class TestEnsembleScheduler:
+    @staticmethod
+    def _ansatz(theta):
+        h = np.stack([np.array([[1, 1], [1, -1]]) / np.sqrt(2),
+                      np.zeros((2, 2))])
+        rz = np.stack([np.diag([np.cos(theta / 2), np.cos(theta / 2)]),
+                       np.diag([-np.sin(theta / 2), np.sin(theta / 2)])])
+        return [C.Gate((0,), h), C.Gate((1,), rz), C.Gate((2,), h)]
+
+    def test_results_match_independent_runs(self, env):
+        sched = qt.EnsembleScheduler(NQ, env, max_batch=8)
+        circuits = [self._ansatz(0.1 * (k + 1)) for k in range(5)]
+        for c in circuits:
+            sched.submit(c)
+        res = sched.drain()
+        assert len(res) == 5
+        for k, c in enumerate(circuits):
+            qi = qt.createQureg(NQ, env)
+            with qt.gateFusion(qi):
+                qi._fusion.gates.extend(c)
+            assert np.array_equal(res[k], np.asarray(qi.amps))
+
+    def test_occupancy_and_throughput_telemetry(self, env):
+        mode = T.mode_name()
+        T.configure("on")
+        try:
+            before = dict(T.snapshot()["counters"])
+            sched = qt.EnsembleScheduler(NQ, env, max_batch=8)
+            for k in range(5):  # pads to a bucket of 8
+                sched.submit(self._ansatz(0.2 * (k + 1)))
+            sched.drain()
+            snap = T.snapshot()
+            total = snap["counters"].get("ensemble_circuits_total", {})
+            prev = before.get("ensemble_circuits_total", {}).get("", 0)
+            assert total.get("", 0) - prev == 5
+            assert snap["gauges"]["batch_occupancy"][""] == 5 / 8
+            assert snap["gauges"]["ensemble_circuits_per_sec"][""] > 0
+        finally:
+            T.configure(mode)
+
+    def test_retrace_count_bounded_by_buckets(self, env):
+        """Submissions of ONE structure at many batch sizes retrace at
+        most once per power-of-two bucket size, never per submission:
+        padding quantizes the (B, 2, 2^n) shapes entering jit."""
+        mode = T.mode_name()
+        T.configure("on")
+        try:
+            sched = qt.EnsembleScheduler(NQ, env, max_batch=8)
+
+            def drained_retraces(counts):
+                t0 = T.snapshot()["counters"].get(
+                    "fusion_retrace_total", {}).get("", 0)
+                for cnt in counts:
+                    for k in range(cnt):
+                        sched.submit(self._ansatz(0.05 * (k + 1)))
+                    sched.drain()
+                t1 = T.snapshot()["counters"].get(
+                    "fusion_retrace_total", {}).get("", 0)
+                return t1 - t0
+
+            # 13 submissions over drains of 1, 3, 4 and 5 circuits hit
+            # buckets {1, 2, 4, 8}: <= 4 retraces, NOT 13
+            retraces = drained_retraces([1, 3, 4, 5])
+            assert retraces <= 4, retraces
+            # the same bucket sizes again: zero new traces
+            assert drained_retraces([1, 3, 4, 5]) == 0
+        finally:
+            T.configure(mode)
+
+    def test_mixed_structures_grouped(self, env):
+        sched = qt.EnsembleScheduler(NQ, env, max_batch=8)
+        a = self._ansatz(0.3)
+        b = self._ansatz(0.4)[:2]  # different structure (2 gates)
+        sched.submit(a)
+        sched.submit(b)
+        sched.submit(self._ansatz(0.5))
+        res = sched.drain()
+        assert len(res) == 3
+        qi = qt.createQureg(NQ, env)
+        with qt.gateFusion(qi):
+            qi._fusion.gates.extend(b)
+        assert np.array_equal(res[1], np.asarray(qi.amps))
+
+
+class TestTrajectories:
+    @staticmethod
+    def _noisy_ops(theta=0.7):
+        ry = np.array([[np.cos(theta / 2), -np.sin(theta / 2)],
+                       [np.sin(theta / 2), np.cos(theta / 2)]])
+        ry_soa = np.stack([ry, np.zeros((2, 2))])
+        ops = [C.Gate((0,), ry_soa), ("dephasing", 0, 0.2),
+               C.Gate((1,), ry_soa), ("depolarising", 1, 0.15),
+               ("damping", 0, 0.25)]
+        return ops, ry
+
+    def test_converges_to_density_channels(self, env):
+        """The trajectory-mean expectation converges to the exact density
+        evolution (ops/density.py channels) of the same noisy circuit —
+        the stochastic unraveling is the same CPTP map."""
+        ops, ry = self._noisy_ops()
+        nq = 2
+        codes = np.array([[3, 0], [0, 3], [1, 1]], dtype=np.int32)
+        coeffs = np.array([1.0, 0.5, 0.25])
+        out = qt.run_trajectories(ops, nq, env, 256,
+                                  observable=(codes, coeffs), seed=5)
+        rho = qt.createDensityQureg(nq, env)
+        qt.unitary(rho, 0, ry)
+        qt.mixDephasing(rho, 0, 0.2)
+        qt.unitary(rho, 1, ry)
+        qt.mixDepolarising(rho, 1, 0.15)
+        qt.mixDamping(rho, 0, 0.25)
+        h = qt.createPauliHamil(nq, 3)
+        h.pauli_codes[:] = codes
+        h.term_coeffs[:] = coeffs
+        exact = qt.calcExpecPauliHamil(rho, h, qt.createQureg(nq, env))
+        assert out["values"].shape == (256,)
+        assert out["sem"] > 0
+        assert abs(out["mean"] - exact) < max(5 * out["sem"], 0.05)
+
+    def test_seed_reproducible(self, env):
+        ops, _ = self._noisy_ops()
+        codes = np.array([[3, 0]], dtype=np.int32)
+        coeffs = np.array([1.0])
+        a = qt.run_trajectories(ops, 2, env, 16,
+                                observable=(codes, coeffs), seed=9)
+        b = qt.run_trajectories(ops, 2, env, 16,
+                                observable=(codes, coeffs), seed=9)
+        assert np.array_equal(a["values"], b["values"])
+
+    def test_trajectories_stay_normalized(self, env):
+        """Every Kraus branch renormalizes its trajectory — the bank
+        stays a bank of unit state vectors (the MCWF invariant)."""
+        ops, _ = self._noisy_ops()
+        out = qt.run_trajectories(ops, 2, env, 32, seed=3)
+        norms = (out["amps"] ** 2).sum(axis=(1, 2))
+        np.testing.assert_allclose(norms, 1.0, atol=1e-12)
+
+
+class TestBatchedCheckpointResume:
+    @staticmethod
+    def _gates(rng, count=12):
+        return [C.Gate((k % NQ,),
+                       np.stack([(u := _random_unitary(rng)).real, u.imag]))
+                for k in range(count)]
+
+    def test_save_load_round_trip(self, env):
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        _apply_circuit(bq, depth=1)
+        want = np.asarray(bq.amps)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "bank")
+            qt.saveQureg(bq, p)
+            q2 = qt.loadQureg(p, env)
+            assert isinstance(q2, qt.BatchedQureg)
+            assert q2.batch_size == NB
+            assert np.array_equal(np.asarray(q2.amps), want)
+
+    def test_resumed_run_bit_identical(self, env):
+        rng = np.random.default_rng(9)
+        gates = self._gates(rng)
+        seeds = [[7 + i] for i in range(NB)]
+        with tempfile.TemporaryDirectory() as d:
+            bq = qt.createBatchedQureg(NQ, env, NB, seeds=seeds)
+            qt.run_resumable(bq, gates, os.path.join(d, "ck"), every=4)
+            full = np.asarray(bq.amps)
+
+            ck2 = os.path.join(d, "ck2")
+            bq2 = qt.createBatchedQureg(NQ, env, NB, seeds=seeds)
+            with pytest.raises(R.SimulatedPreemption):
+                qt.run_resumable(bq2, gates, ck2, every=4,
+                                 faults=R.FaultPlan("kill@2"))
+            bq3 = qt.createBatchedQureg(NQ, env, NB, seeds=seeds)
+            qt.run_resumable(bq3, gates, ck2, every=4)
+            assert np.array_equal(full, np.asarray(bq3.amps))
+            assert bq3.key_state()["counters"] == bq.key_state()["counters"]
+
+    def test_batched_checkpoint_refuses_scalar_register(self, env):
+        rng = np.random.default_rng(10)
+        gates = self._gates(rng, count=8)
+        with tempfile.TemporaryDirectory() as d:
+            ck = os.path.join(d, "ck")
+            bq = qt.createBatchedQureg(NQ, env, NB)
+            qt.run_resumable(bq, gates, ck, every=4)
+            scalar = qt.createQureg(NQ, env)
+            with pytest.raises(QuESTError, match="batch mismatch"):
+                qt.run_resumable(scalar, gates, ck, every=4)
+            wrong = qt.createBatchedQureg(NQ, env, NB * 2)
+            with pytest.raises(QuESTError, match="batch mismatch"):
+                qt.run_resumable(wrong, gates, ck, every=4)
+
+    def test_health_covers_every_element(self, env):
+        bq = qt.createBatchedQureg(NQ, env, NB)
+        norm, finite = qt.checkQuregHealth(bq)
+        assert finite and abs(norm - 1.0) < 1e-12
+        # corrupt ONE element: the reported norm must be the outlier
+        bank = np.array(bq.amps)
+        bank[2] *= 2.0
+        bq.amps = jnp.asarray(bank)
+        norm, finite = qt.checkQuregHealth(bq)
+        assert abs(norm - 4.0) < 1e-12
+
+
+class TestBatchedTelemetry:
+    def test_dispatch_and_exchange_weighted_by_batch(self, env):
+        """dispatch_total counts B logical gate applications per batched
+        call, and window_remap exchange bytes scale by B — telemetry
+        stays truthful under batching."""
+        if env.num_devices < 2:
+            pytest.skip("needs a sharded mesh for exchange accounting")
+        mode = T.mode_name()
+        T.configure("on")
+        try:
+            def unitary_count():
+                c = T.snapshot()["counters"].get("dispatch_total", {})
+                return c.get("family=unitary", 0)
+
+            def remap_bytes():
+                c = T.snapshot()["counters"].get(
+                    "exchange_bytes_total", {})
+                return sum(v for k, v in c.items() if "op=remap" in k)
+
+            u0, b0 = unitary_count(), remap_bytes()
+            qs = qt.createQureg(NQ, env)
+            with qt.gateFusion(qs):
+                qt.hadamard(qs, NQ - 1)  # mesh-coordinate bit: remaps
+            _ = qs.amps
+            u_scalar = unitary_count() - u0
+            b_scalar = remap_bytes() - b0
+
+            u1, b1 = unitary_count(), remap_bytes()
+            bq = qt.createBatchedQureg(NQ, env, NB)
+            qt.hadamard(bq, NQ - 1)
+            _ = bq.amps
+            assert unitary_count() - u1 == NB * u_scalar
+            assert remap_bytes() - b1 == NB * b_scalar > 0
+        finally:
+            T.configure(mode)

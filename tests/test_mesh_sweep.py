@@ -99,7 +99,11 @@ class TestTrotterScanSweep:
             num_qubits=n, rep_qubits=n))
         np.testing.assert_allclose(got, want, atol=1e-12)
 
-    def test_hlo_two_permutes_per_sharded_qubit(self, swept_env):
+    def test_hlo_direct_switch_permutes(self, swept_env):
+        """The direct term body exchanges via ONE lax.switch over the
+        2^r static XOR permutes: the module holds 2^r - 1
+        collective-permutes (one per non-identity branch) regardless of
+        term count."""
         n = 8
         r = _r(swept_env)
         amps = _sharded(swept_env, _rand_soa(n, 300 + r))
@@ -113,7 +117,7 @@ class TestTrotterScanSweep:
                 rep_qubits=n)
 
         assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": 2 * r}
+            "collective-permute": 2 ** r - 1}
 
 
 class TestExpecScanSweep:
@@ -131,7 +135,9 @@ class TestExpecScanSweep:
             num_qubits=n))
         assert abs(got - want) < 1e-12
 
-    def test_hlo_r_permutes_one_allreduce(self, swept_env):
+    def test_hlo_switch_permutes_one_allreduce(self, swept_env):
+        """Direct body: one mesh-flip switch (2^r - 1 branch permutes,
+        at most one executed per term) + ONE final psum."""
         n = 8
         r = _r(swept_env)
         amps = _sharded(swept_env, _rand_soa(n, 500 + r))
@@ -147,7 +153,7 @@ class TestExpecScanSweep:
         permutes = hist.get("collective-permute", 0)
         reduces = (hist.get("all-reduce", 0)
                    + hist.get("all-reduce-start", 0))
-        assert permutes == r and reduces == 1, hist
+        assert permutes == 2 ** r - 1 and reduces == 1, hist
         assert set(hist) <= {"collective-permute", "all-reduce",
                              "all-reduce-start"}, hist
 
