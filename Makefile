@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch bench docs clean
+.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-regress bench docs clean
 
 all: native
 
@@ -56,6 +56,19 @@ verify-telemetry:
 verify-batch:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_batch.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu python scripts/bench_batch.py
+
+# Execution introspection (docs/design.md §21): plan explainer, HLO
+# audit / collective budgets, and the predicted-vs-measured
+# reconciliation contract (explainCircuit == cost model == telemetry
+# counters, model_drift_total == 0 on the 8-shard dryrun).
+verify-introspect:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_introspect.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Regression gate over the committed BENCH_r*.json trajectory: every
+# normalized metric must stay within 15% of its drift-resistant median
+# baseline (scripts/bench_regress.py; --current FILE gates a fresh run).
+verify-regress:
+	python scripts/bench_regress.py --threshold 0.15
 
 bench: native
 	python bench.py

@@ -471,8 +471,66 @@ def config9():
            "results": rows})
 
 
+def config10():
+    """Plan-explainer snapshot (ISSUE 8): dry-run the fusion planner over
+    the config-6 workload (the 8-shard alternating local/sharded 2q
+    stream) with introspect.explain_circuit — no device execution — and
+    dump the per-window report (EXPLAIN_snapshot.json, the predictive
+    twin of config 8's post-hoc TELEMETRY_snapshot.json).  The stream is
+    then actually drained so the timing line carries the reconciliation
+    verdict: predicted vs measured window-remap exchanges and
+    model_drift_total (0 = the cost model holds)."""
+    import quest_tpu as qt
+    from quest_tpu import telemetry
+
+    env = qt.createQuESTEnv()
+    if env.num_devices < 8:
+        _emit(10, "plan-explainer snapshot (SKIPPED: needs 8 amp shards)",
+              0.0, "seconds", 0.0)
+        return
+    n = 10 if CPU else 24
+    depth = 12
+    rng = np.random.default_rng(11)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    prev_mode = telemetry.mode_name()
+    telemetry.configure("on")
+    try:
+        q = qt.createQureg(n, env)
+        qt.startGateFusion(q)
+        for _ in range(depth):
+            qt.multiQubitUnitary(q, [0, 1], u)          # shard-local
+            qt.multiQubitUnitary(q, [n - 2, n - 1], u)  # sharded targets
+        t0 = time.perf_counter()
+        report = qt.explainCircuit(q)   # dry-run: nothing executes
+        explain_s = time.perf_counter() - t0
+        path = os.path.abspath("EXPLAIN_snapshot.json")
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+        telemetry.reset()
+        qt.stopGateFusion(q)            # the real drain
+        measured = telemetry.counter_sum("exchanges_total",
+                                         op="window_remap")
+        measured_bytes = telemetry.counter_sum("exchange_bytes_total",
+                                               op="window_remap")
+        _set_compile(0.0)  # the explainer never traces
+        _emit(10, f"{n}q 8-shard plan-explainer dryrun", explain_s,
+              "seconds", explain_s,
+              {"snapshot_file": path,
+               "windows": report["totals"]["windows"],
+               "predicted_exchanges": report["totals"]["exchanges"],
+               "predicted_exchange_bytes":
+                   report["totals"]["exchange_bytes"],
+               "measured_exchanges": measured,
+               "measured_exchange_bytes": measured_bytes,
+               "model_drift_total": telemetry.counter_total(
+                   "model_drift_total")})
+    finally:
+        telemetry.configure(prev_mode)
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
 
 
 def main():

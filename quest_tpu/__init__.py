@@ -69,6 +69,15 @@ from .debug import (
 )
 from . import telemetry
 from .telemetry import report_perf as reportPerf, report_perf
+from . import introspect
+from .introspect import (
+    explain_circuit,
+    explain_circuit as explainCircuit,
+    report_circuit_plan,
+    report_circuit_plan as reportCircuitPlan,
+    audit,
+    CollectiveBudget,
+)
 from .ops import phasefunc as _pf
 
 # enum phaseFunc (QuEST.h:231-234)

@@ -395,6 +395,18 @@ def _bucket_size(count: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+def bank_occupancy(qureg) -> dict:
+    """Bucket occupancy of a batched register for the plan explainer
+    (introspect.explain_circuit): the live batch size, the power-of-two
+    bucket it pads to, and the real/padded fraction — the same quantity
+    EnsembleScheduler publishes as the ``batch_occupancy`` gauge."""
+    bsz = int(getattr(qureg, "batch_size", 0) or 0)
+    if not bsz:
+        return {"size": 0, "bucket": 0, "occupancy": 1.0}
+    bucket = _bucket_size(bsz, 1 << 30)
+    return {"size": bsz, "bucket": bucket, "occupancy": bsz / bucket}
+
+
 def _structure_fingerprint(gates: Sequence, num_qubits: int,
                            is_density: bool) -> tuple:
     """Hashable circuit STRUCTURE (targets + matrix shapes, not values):

@@ -308,6 +308,11 @@ def get_environment_string(env: QuESTEnv) -> str:
     timeouts = telemetry.counter_total("exchange_timeouts_total")
     if timeouts:
         s += f" ExchangeTimeouts={int(timeouts)}"
+    # peak HBM watermark over devices (hbm_watermark_bytes gauge, sampled
+    # by the fusion drain at window boundaries — utils/profiling.py)
+    peak = telemetry.gauge_max("hbm_watermark_bytes")
+    if peak is not None:
+        s += f" HbmPeak={int(peak)}"
     s += f" [telemetry: {telemetry.summary()}]"
     return s
 

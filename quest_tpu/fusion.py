@@ -85,6 +85,12 @@ def drain(qureg) -> None:
         # window-boundary accounting for the resilience layer: checkpoint
         # cadence is asserted against drains, never mid-window
         qureg._drain_count = getattr(qureg, "_drain_count", 0) + 1
+        if _telemetry.enabled():
+            # window-boundary HBM watermark sample (hbm_watermark_bytes
+            # gauge; peak surfaced in getEnvironmentString / reportPerf)
+            from .utils import profiling as _prof
+
+            _prof.memory_watermark()
 
 
 _PLAN_CACHE_MAX = 64
@@ -294,18 +300,35 @@ def _run(qureg, items) -> None:
 
             itemsize = np.dtype(qureg.dtype).itemsize
             ck = str(PAR.exchange_config_key() or "auto")
+            meas_c0 = _telemetry.counter_sum("exchanges_total",
+                                             op="window_remap")
+            meas_b0 = _telemetry.counter_sum("exchange_bytes_total",
+                                             op="window_remap")
             for part in program:
                 if part[0] != "remap":
                     continue
                 sigma = part[1]
-                mixed, _lp, mesh_tau = PAR.decompose_sigma(sigma, nloc, nsh)
-                cnt = len(mixed) + (1 if mesh_tau is not None else 0)
+                cnt = PAR.remap_exchange_count(sigma, nloc, nsh)
                 if cnt:
                     _telemetry.record_exchange(
                         "window_remap", cnt * bw,
                         bw * C.remap_exchange_bytes(sigma, n, nloc,
                                                     itemsize),
                         chunks=ck)
+            # reconcile the drain's measured window-remap deltas against
+            # an independent re-plan through the cost model — any
+            # disagreement is model drift (introspect, docs/design.md §21)
+            from . import introspect as _introspect
+
+            _introspect.reconcile_drain(
+                bit_sets=[_item_bits(it) for it in items],
+                n=n, nloc=nloc, nsh=nsh, perm0=perm0, itemsize=itemsize,
+                batch=bsz,
+                measured_count=_telemetry.counter_sum(
+                    "exchanges_total", op="window_remap") - meas_c0,
+                measured_bytes=_telemetry.counter_sum(
+                    "exchange_bytes_total", op="window_remap") - meas_b0,
+                measured_chunks=ck)
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
     if nsh:

@@ -1528,6 +1528,30 @@ def decompose_sigma(sigma: Tuple[int, ...], nloc: int, r: int):
     return tuple(mixed), local_perm, mesh_tau
 
 
+def remap_exchange_count(sigma: Tuple[int, ...], nloc: int, r: int) -> int:
+    """Number of exchange programs one remap of ``sigma`` dispatches —
+    one half-shard ppermute per mixed transposition plus one composed
+    full-shard ppermute when a residual mesh permute remains.  This is
+    the ``exchanges_total`` increment remap_sharded / the fusion drain
+    record per (unbatched) remap; introspect.predict_window_exchanges
+    re-derives drain telemetry from it (companion of
+    circuit.remap_exchange_bytes on the count axis)."""
+    mixed, _local_perm, mesh_tau = decompose_sigma(tuple(sigma), nloc, r)
+    return len(mixed) + (1 if mesh_tau is not None else 0)
+
+
+def remap_chunk_plan(nloc: int, itemsize: int = 8,
+                     backend: Optional[str] = None) -> Tuple[int, int]:
+    """The (half_shard_chunks, full_shard_chunks) pair the
+    PIPELINE_MIN_BYTES policy resolves for one per-element shard of
+    ``2 * 2^nloc * itemsize`` bytes — the default _remap_in_shard
+    computes at trace time, exposed so the plan explainer can predict
+    the pipeline split without tracing."""
+    nbytes = 2 * (1 << nloc) * itemsize
+    return (exchange_chunks(nbytes // 2, backend=backend),
+            exchange_chunks(nbytes, backend=backend))
+
+
 def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
                     chunks: Optional[Tuple[int, int]] = None):
     """Apply the physical bit permutation ``sigma`` INSIDE a shard_map
@@ -1544,8 +1568,7 @@ def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
     r = int(math.log2(ndev))
     mixed, local_perm, mesh_tau = decompose_sigma(sigma, nloc, r)
     if chunks is None:
-        nbytes = 2 * (1 << nloc) * local.dtype.itemsize
-        chunks = (exchange_chunks(nbytes // 2), exchange_chunks(nbytes))
+        chunks = remap_chunk_plan(nloc, local.dtype.itemsize)
     ch_half = min(_pow2_floor(chunks[0]), 1 << max(nloc - 1, 0))
     ch_full = min(_pow2_floor(chunks[1]), 1 << nloc)
     for lb, mb in mixed:
@@ -1584,8 +1607,7 @@ def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
 
         r = num_shard_bits(mesh)
         nloc = num_qubits - r
-        mixed, _lp, mesh_tau = decompose_sigma(tuple(sigma), nloc, r)
-        cnt = len(mixed) + (1 if mesh_tau is not None else 0)
+        cnt = remap_exchange_count(tuple(sigma), nloc, r)
         bw = int(amps.shape[0]) if amps.ndim == 3 else 1
         if cnt:
             _telemetry.record_exchange(

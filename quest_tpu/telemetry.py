@@ -374,6 +374,31 @@ def counter_value(name: str, /, **labels) -> float:
     return float(c.get((name, _label_key(labels)), 0))
 
 
+def counter_sum(name: str, /, **labels) -> float:
+    """Sum of the counter ``name`` over every series whose labels are a
+    SUPERSET of ``labels`` — e.g. ``counter_sum("exchanges_total",
+    op="window_remap")`` folds the per-chunk-config series into the one
+    total the reconciliation loop compares against its prediction."""
+    if not _mode:
+        return 0.0
+    want = _label_key(labels)
+    c, _g, _h = _series()
+    return float(sum(
+        v for (n, l), v in c.items()
+        if n == name and set(want) <= set(l)))
+
+
+def gauge_max(name: str) -> Optional[float]:
+    """Max of the gauge ``name`` across its label sets (None when absent
+    or telemetry is off) — e.g. the peak ``hbm_watermark_bytes`` over
+    devices for getEnvironmentString / reportPerf."""
+    if not _mode:
+        return None
+    _c, g, _h = _series()
+    vals = [v for (n, _l), v in g.items() if n == name]
+    return max(vals) if vals else None
+
+
 def _esc(v: str) -> str:
     return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
 
@@ -387,6 +412,12 @@ def _prom_labels(labels: tuple, extra: tuple = ()) -> str:
 
 def _num(v: float) -> str:
     f = float(v)
+    # the text exposition format spells non-finite values +Inf/-Inf/NaN;
+    # Python's repr() says inf/nan, which Prometheus parsers reject
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
     return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
 
 
@@ -477,6 +508,23 @@ def perf_report(env=None) -> str:
                     f"  {name}{tag}: count={hd['count']} "
                     f"sum={hd['sum']:.6g} mean={mean:.6g} "
                     f"max={hd['max'] if hd['max'] is not None else '-'}")
+    pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
+    meas_c = counter_sum("exchanges_total", op="window_remap")
+    pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")
+    meas_b = counter_sum("exchange_bytes_total", op="window_remap")
+    drift = counter_total("model_drift_total")
+    if pred_c or meas_c or drift:
+        lines.append("reconciliation (window remaps, predicted vs measured):")
+        lines.append(f"  exchanges: predicted={_num(pred_c)} "
+                     f"measured={_num(meas_c)}")
+        lines.append(f"  bytes/shard: predicted={_num(pred_b)} "
+                     f"measured={_num(meas_b)}")
+        verdict = ("MODEL DRIFT" if drift else "cost model holds")
+        lines.append(f"  model_drift_total={_num(drift)} ({verdict})")
+    peak = gauge_max("hbm_watermark_bytes")
+    if peak is not None:
+        lines.append(f"memory: hbm_watermark_bytes peak={_num(peak)} "
+                     f"({peak / (1 << 20):.1f} MiB)")
     return "\n".join(lines)
 
 

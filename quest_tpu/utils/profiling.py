@@ -62,8 +62,14 @@ def memory_watermark() -> dict:
     ``jax.local_devices()[i].memory_stats()``, with a graceful fallback
     to an empty dict on backends that expose none (CPU returns None).
     Byte watermarks are also published as telemetry gauges
-    (``device_bytes_in_use`` / ``device_peak_bytes_in_use{device}``)."""
+    (``device_bytes_in_use`` / ``device_peak_bytes_in_use{device}``, and
+    the consolidated ``hbm_watermark_bytes{device}`` the fusion drain
+    samples at window boundaries — peak surfaced in
+    getEnvironmentString and reportPerf).  When NO device exposes
+    memory_stats (the CPU backend), the host process max-RSS stands in
+    under ``device="host"`` so the watermark loop stays testable."""
     out: dict = {}
+    saw_device_stats = False
     for d in jax.local_devices():
         try:
             stats = d.memory_stats()
@@ -77,4 +83,17 @@ def memory_watermark() -> dict:
         if "peak_bytes_in_use" in stats:
             _telemetry.set_gauge("device_peak_bytes_in_use",
                                  stats["peak_bytes_in_use"], device=str(d))
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if peak is not None:
+            saw_device_stats = True
+            _telemetry.set_gauge("hbm_watermark_bytes", peak,
+                                 device=str(d))
+    if not saw_device_stats:
+        try:
+            import resource
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            _telemetry.set_gauge("hbm_watermark_bytes", rss, device="host")
+        except Exception:  # pragma: no cover - non-POSIX host
+            pass
     return out

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Regression gate over the committed benchmark trajectory.
+
+The repo carries one ``BENCH_r<N>.json`` artifact per benchmark round
+(the driver's capture of ``bench.py`` / ``bench_suite.py`` output), but
+until now the trajectory was write-only: nothing failed when a round
+got slower.  This script (make verify-regress) closes the loop of
+docs/design.md §21:
+
+1. Every round is normalized to a flat ``{key: value}`` metric map with
+   a per-key better-direction — the headline gate-apply rate (higher is
+   better), every per-config K-diff / eager / fused timing median
+   (lower is better), and per-config throughput rates.  Rounds whose
+   ``parsed`` payload was lost to output truncation are recovered from
+   the raw ``tail`` text by regex.
+2. The candidate (default: the LATEST committed round; ``--current
+   FILE`` for a fresh ``bench.py`` dict or ``bench_suite.py`` JSON-lines
+   capture) is compared per key against the MEDIAN of all prior rounds
+   carrying that key — the drift-resistant baseline: one anomalous
+   round moves the median far less than a last-round or best-round
+   baseline, so a regression is charged against the trajectory's
+   consensus, not against noise.
+3. Any key worse than the median baseline by more than ``--threshold``
+   (default 15%) in its worse direction fails the gate (exit 1).
+   Cross-backend comparisons (a CPU smoke run against the committed TPU
+   trajectory) are skipped with a note — the numbers are not
+   commensurable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# units where larger is better; timing medians are lower-better
+_RATE_UNITS = ("per_sec", "per_second")
+
+# variant sub-dicts of a bench.py per-config record that carry a
+# {"median": ...} timing (kdiff, eager, fused_sweep_on, api_wall, ...)
+_MEDIAN_RE = re.compile(r'"(\w+)": \{"median": ([-0-9.eE]+)')
+_CONFIG_SPLIT_RE = re.compile(r'"(\d+)": \{"metric":')
+
+
+def _higher_better(unit: str) -> bool:
+    return any(t in unit for t in _RATE_UNITS)
+
+
+def _norm_configs(configs: dict, out: dict) -> None:
+    for num, cfg in configs.items():
+        if not isinstance(cfg, dict):
+            continue
+        for variant, sub in cfg.items():
+            if isinstance(sub, dict) and "median" in sub:
+                out[f"config{num}:{variant}_median"] = (
+                    float(sub["median"]), False)
+            elif variant.endswith("_per_sec") and isinstance(
+                    sub, (int, float)):
+                out[f"config{num}:{variant}"] = (float(sub), True)
+
+
+def _recover_from_tail(tail: str) -> dict:
+    """A round whose ``parsed`` payload is None lost its final JSON to
+    front-truncation of the captured output; the per-config variant
+    medians survive in the text and are recovered positionally."""
+    out: dict = {}
+    marks = [(m.start(), m.group(1)) for m in _CONFIG_SPLIT_RE.finditer(tail)]
+    for i, (pos, num) in enumerate(marks):
+        end = marks[i + 1][0] if i + 1 < len(marks) else len(tail)
+        seg = tail[pos:end]
+        for variant, med in _MEDIAN_RE.findall(seg):
+            out[f"config{num}:{variant}_median"] = (float(med), False)
+        m = re.search(r'"amp_updates_per_sec": ([-0-9.eE]+)', seg)
+        if m:
+            out[f"config{num}:amp_updates_per_sec"] = (float(m.group(1)),
+                                                       True)
+    return out
+
+
+def normalize_round(record: dict) -> tuple:
+    """One ``BENCH_r*.json`` record -> (metrics, backend) where metrics
+    is {key: (value, higher_better)}."""
+    parsed = record.get("parsed")
+    out: dict = {}
+    backend = None
+    if isinstance(parsed, dict):
+        backend = parsed.get("backend")
+        unit = parsed.get("unit", "")
+        if "value" in parsed and unit:
+            out[f"headline:{unit}"] = (float(parsed["value"]),
+                                       _higher_better(unit))
+        if isinstance(parsed.get("configs"), dict):
+            _norm_configs(parsed["configs"], out)
+    else:
+        out = _recover_from_tail(record.get("tail") or "")
+    # bench.py's config 2 IS the headline metric (26q depth-20 gate-apply
+    # rate): alias it so rounds whose top-level record was truncated away
+    # still extend the multi-round headline trajectory
+    if ("headline:amp_updates_per_sec" not in out
+            and "config2:amp_updates_per_sec" in out):
+        out["headline:amp_updates_per_sec"] = \
+            out["config2:amp_updates_per_sec"]
+    return out, backend
+
+
+def normalize_current(path: str) -> tuple:
+    """A fresh benchmark capture: either one bench.py JSON dict or
+    bench_suite.py JSON lines (one ``{"config": N, ...}`` record per
+    line; non-JSON lines ignored)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        return normalize_round({"parsed": doc})
+    out: dict = {}
+    backend = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or "config" not in rec:
+            continue
+        backend = rec.get("backend", backend)
+        unit = rec.get("unit", "")
+        num = rec["config"]
+        if "value" in rec and unit:
+            out[f"config{num}:{unit}"] = (float(rec["value"]),
+                                          _higher_better(unit))
+        if "seconds" in rec:
+            out[f"config{num}:seconds"] = (float(rec["seconds"]), False)
+    return out, backend
+
+
+def load_rounds(bench_dir: str) -> list:
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except ValueError:
+            continue
+        metrics, backend = normalize_round(record)
+        if metrics:
+            rounds.append({"name": os.path.basename(path),
+                           "metrics": metrics, "backend": backend})
+    return rounds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional regression vs the median baseline "
+                         "that fails the gate (default 0.15)")
+    ap.add_argument("--current", default=None,
+                    help="fresh benchmark capture to gate (bench.py JSON "
+                         "or bench_suite JSON lines); default: the latest "
+                         "committed BENCH_r*.json round")
+    ap.add_argument("--bench-dir", default=REPO,
+                    help="directory holding BENCH_r*.json")
+    ap.add_argument("--min-rounds", type=int, default=2,
+                    help="prior rounds a key needs before it is gated — "
+                         "a single-point baseline is last-round diffing, "
+                         "not a drift-resistant median (default 2)")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.bench_dir)
+    if args.current:
+        cand_metrics, cand_backend = normalize_current(args.current)
+        cand_name = args.current
+        history = rounds
+    else:
+        if len(rounds) < 2:
+            print("bench_regress: need >= 2 normalizable BENCH_r*.json "
+                  "rounds (or --current); nothing to gate")
+            return 0
+        cand = rounds[-1]
+        cand_metrics, cand_backend = cand["metrics"], cand["backend"]
+        cand_name = cand["name"]
+        history = rounds[:-1]
+    if not cand_metrics:
+        print(f"bench_regress: no metrics recognized in {cand_name}")
+        return 1
+
+    print(f"bench_regress: candidate={cand_name} "
+          f"baseline=median of {len(history)} prior round(s) "
+          f"threshold={args.threshold:.0%}")
+    failures = 0
+    compared = 0
+    for key in sorted(cand_metrics):
+        value, higher = cand_metrics[key]
+        prior = []
+        for r in history:
+            if key not in r["metrics"]:
+                continue
+            if (cand_backend and r["backend"]
+                    and r["backend"] != cand_backend):
+                print(f"  SKIP {key}: backend {cand_backend} vs "
+                      f"{r['backend']} trajectory (not commensurable)")
+                prior = []
+                break
+            prior.append(r["metrics"][key][0])
+        if not prior:
+            continue
+        if len(prior) < args.min_rounds:
+            print(f"        note {key}: only {len(prior)} prior round(s) "
+                  f"(< --min-rounds {args.min_rounds}); not gated")
+            continue
+        base = statistics.median(prior)
+        compared += 1
+        if base == 0:
+            continue
+        # signed fractional change in the WORSE direction
+        delta = (base - value) / abs(base) if higher \
+            else (value - base) / abs(base)
+        tag = "ok"
+        if delta > args.threshold:
+            tag = "REGRESSION"
+            failures += 1
+        arrow = "higher-better" if higher else "lower-better"
+        print(f"  {tag:>10} {key}: {value:.6g} vs median {base:.6g} "
+              f"({arrow}, worse by {delta:+.1%})")
+    if not compared:
+        print("bench_regress: no overlapping keys with the trajectory; "
+              "nothing gated")
+        return 0
+    if failures:
+        print(f"bench_regress: FAIL — {failures} metric(s) regressed "
+              f"> {args.threshold:.0%} vs the trajectory median")
+        return 1
+    print(f"bench_regress: PASS — {compared} metric(s) within "
+          f"{args.threshold:.0%} of the trajectory median")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

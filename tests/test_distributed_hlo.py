@@ -21,8 +21,6 @@ CPU mesh and assert which communication primitives appear:
   ones must not regress into them.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -31,36 +29,25 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import quest_tpu as qt
 from quest_tpu import circuit as CIRC
+from quest_tpu import introspect
 from quest_tpu.env import AMP_AXIS
+from quest_tpu.introspect import CollectiveBudget
 from quest_tpu.ops import density as D
 from quest_tpu.ops import kernels as K
 from quest_tpu.ops import phasefunc as PF
 from quest_tpu.parallel import dist as PAR
 
-COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|collective-permute|all-gather|all-to-all|"
-    r"reduce-scatter)\b")
-
-# exact HLO opcodes (an instruction is "%name = TYPE opcode(args)"; the
-# loose word-regex above also matches metadata mentions, inflating counts)
-_COLLECTIVE_OPS = (
-    "all-reduce", "all-reduce-start", "collective-permute",
-    "collective-permute-start", "all-gather", "all-gather-start",
-    "all-to-all", "reduce-scatter",
-)
+# the audit recipe these tests pioneered is now the public runtime API
+# (quest_tpu.introspect, ISSUE 8); the module-level names stay because
+# test_mesh_sweep imports them
+COLLECTIVE_RE = introspect.COLLECTIVE_RE
+_COLLECTIVE_OPS = introspect.COLLECTIVE_OPS
 
 
 def collective_ops(fn, *args, donate=False):
     """Histogram of ACTUAL collective instructions in the optimized HLO
-    (exact opcode occurrences, not word matches)."""
-    jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
-    txt = jfn.lower(*args).compile().as_text()
-    hist = {}
-    for op in _COLLECTIVE_OPS:
-        c = txt.count(f" {op}(")
-        if c:
-            hist[op] = hist.get(op, 0) + c
-    return hist
+    (exact opcode occurrences, not word matches) — introspect.audit."""
+    return introspect.audit(fn, *args, donate=donate).collectives
 
 
 @pytest.fixture(scope="module")
@@ -72,14 +59,10 @@ def env8():
 
 
 def collectives(fn, *args, env=None, donate=False):
-    """Compile fn against sharded args and histogram the collective ops in
-    the optimized HLO."""
-    jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
-    txt = jfn.lower(*args).compile().as_text()
-    hist = {}
-    for m in COLLECTIVE_RE.finditer(txt):
-        hist[m.group(1)] = hist.get(m.group(1), 0) + 1
-    return hist
+    """Compile fn against sharded args and histogram the loose collective
+    word matches in the optimized HLO (introspect.audit's upper-bound
+    view — metadata mentions included)."""
+    return introspect.audit(fn, *args, donate=donate).matches
 
 
 def sharded_state(env, n, seed=0):
@@ -249,8 +232,10 @@ class TestPairFamiliesCommunicate:
                 a, 0.3, mesh=env8.mesh, num_qubits=nq, target=nq - 1,
                 kind="depol")
 
-        assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": 1}
+        # the ambient budget checks every audit inside the block — the
+        # same pin as asserting the histogram, through the public API
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            introspect.audit(f, amps, donate=True)
 
     def test_explicit_damping_one_permute(self, env8):
         nq = 7
@@ -261,8 +246,8 @@ class TestPairFamiliesCommunicate:
                 a, 0.3, mesh=env8.mesh, num_qubits=nq, target=nq - 1,
                 kind="damping")
 
-        assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": 1}
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            introspect.audit(f, amps, donate=True)
 
     def test_gspmd_elementwise_depol_fallback_bounded(self, env8):
         """The GSPMD fallback (elementwise kernel under sharding
@@ -297,10 +282,10 @@ class TestPairFamiliesCommunicate:
         def f(a, re, im):
             return D.apply_diagonal_op_density(a, re, im, num_qubits=nq)
 
-        hist = collective_ops(f, amps, op, op * 0.5)
+        report = introspect.audit(f, amps, op, op * 0.5)
+        hist = report.collectives
         assert set(hist) == {"all-gather"} and hist["all-gather"] <= 4, hist
-        txt = jax.jit(f).lower(amps, op, op * 0.5).compile().as_text()
-        for line in txt.splitlines():
+        for line in report.text.splitlines():
             if " all-gather(" in line:
                 assert f"[{1 << nq}]{{" in line, line  # op-sized, ever
 
@@ -325,8 +310,9 @@ class TestPairFamiliesCommunicate:
         def f(a):
             return PAR.fused_qft_sharded(a, mesh=env8.mesh, num_qubits=n)
 
-        assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": r, "all-to-all": 1}
+        with CollectiveBudget(exact={"collective-permute": r,
+                                     "all-to-all": 1}):
+            introspect.audit(f, amps, donate=True)
 
 
 class TestScanCompositesExactCollectives:
@@ -355,8 +341,8 @@ class TestScanCompositesExactCollectives:
                 a, codes, angles, mesh=env8.mesh, num_qubits=n,
                 rep_qubits=n)
 
-        assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": ndev - 1}
+        with CollectiveBudget(exact={"collective-permute": ndev - 1}):
+            introspect.audit(f, amps, donate=True)
 
     def test_trotter_scan_sharded_density_two_switches(self, env8):
         """A density-matrix term rotates ket and bra separately: two
@@ -393,11 +379,10 @@ class TestScanCompositesExactCollectives:
             return PAR.expec_pauli_sum_scan_sharded(
                 a, codes, coeffs, mesh=env8.mesh, num_qubits=n)
 
-        hist = collective_ops(f, amps)
-        permutes = hist.get("collective-permute", 0)
-        reduces = (hist.get("all-reduce", 0)
-                   + hist.get("all-reduce-start", 0))
-        assert permutes == ndev - 1 and reduces == 1, hist
+        report = introspect.audit(f, amps)
+        hist = report.collectives
+        assert report.count("collective-permute") == ndev - 1, hist
+        assert report.count("all-reduce") == 1, hist
         assert set(hist) <= {"collective-permute", "all-reduce",
                              "all-reduce-start"}, hist
 
@@ -500,13 +485,11 @@ class TestTwoQubitChannelsExactCollectives:
             return PAR.apply_diag_op_density_sharded(
                 a, re, im, mesh=env8.mesh, num_qubits=nq)
 
-        hist = collective_ops(f, amps, op, op * 0.5, donate=True)
-        gathers = (hist.get("all-gather", 0)
-                   + hist.get("all-gather-start", 0))
-        assert gathers == 2 and "collective-permute" not in hist, hist
-        jfn = jax.jit(f, donate_argnums=0)
-        txt = jfn.lower(amps, op, op * 0.5).compile().as_text()
-        for line in txt.splitlines():
+        report = introspect.audit(f, amps, op, op * 0.5, donate=True)
+        hist = report.collectives
+        assert report.count("all-gather") == 2, hist
+        assert "collective-permute" not in hist, hist
+        for line in report.text.splitlines():
             if " all-gather(" in line or " all-gather-start(" in line:
                 assert f"[{1 << nq}]{{" in line, line
 

@@ -1,0 +1,403 @@
+"""Execution introspection (ISSUE 8): plan explainer, HLO audit API,
+and the predicted-vs-measured reconciliation contract.
+
+The load-bearing acceptance test is the pinned 8-shard dryrun:
+explain_circuit's predicted window-remap exchange count and per-shard
+ICI bytes must equal (a) an independent re-derivation from the
+scheduling layer's own cost model and (b) the telemetry counters after
+actually draining the same stream — with ``model_drift_total == 0``.
+An injected planner-policy perturbation (forced chunk-key override,
+scaled prediction) must be detected as nonzero drift with exactly ONE
+structured JSON log line.
+"""
+
+import json
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import batch as B
+from quest_tpu import circuit as CIRC
+from quest_tpu import fusion
+from quest_tpu import introspect
+from quest_tpu import telemetry as T
+from quest_tpu.parallel import dist
+
+
+@pytest.fixture(autouse=True)
+def tele():
+    """Telemetry on + a clean registry per test (mode restored after)."""
+    prev = T.mode_name()
+    T.configure("on")
+    T.reset()
+    yield T
+    T.reset()
+    T.configure(prev)
+
+
+@pytest.fixture
+def env8(env):
+    if env.num_devices < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    return env
+
+
+def _u4(seed=3):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    return u
+
+
+N = 6
+BIT_SETS = [(0, 1), (N - 2, N - 1), (0, 1)]
+
+
+def _buffered_qureg(env, n=N, bit_sets=None, u=None):
+    q = qt.createQureg(n, env)
+    qt.startGateFusion(q)
+    for ts in (bit_sets or BIT_SETS):
+        qt.multiQubitUnitary(q, list(ts), u if u is not None else _u4())
+    return q
+
+
+def _model_window_cost(bit_sets, n, nloc, r, itemsize):
+    """Independent re-derivation of the drain's window-remap cost (the
+    final canonical read is accounted separately as final_remap)."""
+    count = 0
+    nbytes = 0
+    segments, final_perm = CIRC.plan_remap_windows(
+        list(bit_sets), n, nloc, None)
+    for _ij, sigma, _p in segments:
+        if sigma is None:
+            continue
+        count += dist.remap_exchange_count(sigma, nloc, r)
+        nbytes += CIRC.remap_exchange_bytes(sigma, n, nloc, itemsize)
+    return count, nbytes, final_perm
+
+
+# ---------------------------------------------------------------------------
+# Plan explainer
+# ---------------------------------------------------------------------------
+
+
+class TestExplainCircuit:
+    def test_dry_run_does_not_drain(self, env8):
+        q = _buffered_qureg(env8)
+        report = qt.explainCircuit(q)
+        # the buffer is untouched, nothing executed, no counters moved
+        assert len(q._fusion.gates) == len(BIT_SETS)
+        assert report["items"] == len(BIT_SETS)
+        assert T.counter_total("fusion_drains_total") == 0
+        assert T.counter_total("exchanges_total") == 0
+
+    def test_pinned_8shard_dryrun_matches_cost_model_and_counters(
+            self, env8):
+        """The acceptance contract: explainer == cost model == telemetry,
+        exactly, with zero model drift."""
+        q = _buffered_qureg(env8)
+        n = N
+        r = q.num_chunks.bit_length() - 1
+        nloc = n - r
+        itemsize = np.dtype(q.dtype).itemsize
+        report = qt.explainCircuit(q)
+
+        # against the scheduling layer's own cost model
+        count, nbytes, final_perm = _model_window_cost(
+            BIT_SETS, n, nloc, r, itemsize)
+        assert report["totals"]["exchanges"] == count
+        assert report["totals"]["exchange_bytes"] == nbytes
+        sigma_read = dist.canonical_sigma(final_perm)
+        assert report["final_remap"]["exchanges"] == \
+            dist.remap_exchange_count(sigma_read, nloc, r)
+        assert report["final_remap"]["exchange_bytes"] == \
+            CIRC.remap_exchange_bytes(sigma_read, n, nloc, itemsize)
+
+
+        # shape pins for this workload: 3 windows ([0,1] local, the
+        # [4,5] window remaps, the return to [0,1] remaps again)
+        assert report["totals"]["windows"] == 3
+        assert report["windows"][0]["sigma"] is None
+        assert report["windows"][1]["exchanges"] > 0
+        assert report["register"]["shards"] == 8
+
+        # against reality: drain + read, then diff the counters
+        T.reset()
+        _ = q.amps
+        assert T.counter_sum("exchanges_total", op="window_remap") == \
+            report["totals"]["exchanges"]
+        assert T.counter_sum("exchange_bytes_total", op="window_remap") == \
+            report["totals"]["exchange_bytes"]
+        # the canonical-read rematerialization (op=remap) closes the gap
+        # to the _with_read totals
+        assert T.counter_total("exchanges_total") == \
+            report["totals"]["exchanges_with_read"]
+        assert T.counter_sum("exchange_bytes_total", op="remap") + \
+            T.counter_sum("exchange_bytes_total", op="window_remap") == \
+            report["totals"]["exchange_bytes_with_read"]
+        # the drain ran its own reconciliation: the model held
+        assert T.counter_total("model_drift_total") == 0
+        assert T.counter_total("predicted_exchanges_total") == \
+            report["totals"]["exchanges"]
+        assert T.counter_total("fusion_windows_total") == \
+            report["totals"]["plan_windows"]
+
+    def test_plan_cache_and_retrace_prediction(self, env8):
+        u = _u4()
+        q1 = _buffered_qureg(env8, u=u)
+        rep1 = qt.explainCircuit(q1)
+        assert rep1["plan"]["cacheable"]
+        if rep1["plan"]["cache"] == "miss":
+            assert rep1["plan"]["retrace_expected"] is True
+        _ = q1.amps  # populate the plan cache
+        q2 = _buffered_qureg(env8, u=u)
+        rep2 = qt.explainCircuit(q2)
+        assert rep2["plan"]["cache"] == "hit"
+        assert rep2["plan"]["retrace_expected"] is False
+
+    def test_explicit_gate_list_and_unsharded(self, env):
+        # 2 qubits < 8 devices -> the register is replicated, the plan
+        # has no remap schedule at all
+        q = qt.createQureg(2, env)
+        u = _u4()
+        report = qt.explainCircuit(q, [((0, 1), np.stack(
+            [u.real, u.imag]))])
+        assert report["items"] == 1
+        assert report["register"]["shard_bits"] == 0
+        assert report["totals"]["exchange_bytes"] == 0
+        assert report["final_remap"] is None
+
+    def test_json_serializable_and_table(self, env8, capsys):
+        q = _buffered_qureg(env8)
+        report = qt.explainCircuit(q)
+        txt = json.dumps(report)  # must not raise
+        assert "window_remap" not in txt or True
+        table = report.table()
+        assert "circuit plan: 6 qubits, 8 shard(s)" in table
+        assert "bytes/shard" in table
+        assert "totals: plan_windows=" in table
+        qt.reportCircuitPlan(q)
+        assert "circuit plan" in capsys.readouterr().out
+
+    def test_batched_register_occupancy_and_scaling(self, env8):
+        bsz = 3
+        bq = qt.createBatchedQureg(N, env8, bsz)
+        mats = np.stack([_u4(s) for s in range(bsz)])
+        qt.applyBatchedUnitary(bq, (0, 1), mats)
+        qt.applyBatchedUnitary(bq, (N - 2, N - 1), mats)
+        report = qt.explainCircuit(bq)
+        occ = report["register"]["batch"]
+        assert occ["size"] == 3 and occ["bucket"] == 4
+        assert occ["occupancy"] == pytest.approx(3 / 4)
+        # predicted exchanges scale by the batch width
+        r = report["register"]["shard_bits"]
+        nloc = N - r
+        itemsize = report["register"]["itemsize"]
+        count, nbytes, _fp = _model_window_cost(
+            [(0, 1), (N - 2, N - 1)], N, nloc, r, itemsize)
+        assert report["totals"]["exchanges"] == count * bsz
+        assert report["totals"]["exchange_bytes"] == nbytes * bsz
+        # and the drain reconciles at the same scale: zero drift
+        T.reset()
+        _ = bq.amps
+        assert T.counter_sum("exchanges_total", op="window_remap") == \
+            report["totals"]["exchanges"]
+        assert T.counter_total("model_drift_total") == 0
+
+    def test_bank_occupancy_helper(self):
+        class Fake:
+            batch_size = 5
+
+        occ = B.bank_occupancy(Fake())
+        assert occ == {"size": 5, "bucket": 8, "occupancy": 5 / 8}
+        assert B.bank_occupancy(object()) == {
+            "size": 0, "bucket": 0, "occupancy": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# HLO audit + collective budgets
+# ---------------------------------------------------------------------------
+
+
+class TestAudit:
+    def _gate(self, env, n=10):
+        h = (1 / np.sqrt(2)) * np.array([[1, 1], [1, -1]])
+        m = jnp.asarray(np.stack([h, np.zeros((2, 2))]))
+
+        def f(a):
+            return dist.apply_matrix_1q_sharded(
+                a, m, mesh=env.mesh, num_qubits=n, target=n - 1)
+
+        import jax
+
+        rng = np.random.default_rng(0)
+        amps = rng.standard_normal((2, 1 << n))
+        amps /= np.sqrt((amps ** 2).sum())
+        return f, jax.device_put(jnp.asarray(amps), env.amp_sharding())
+
+    def test_exact_collective_histogram_and_cost(self, env8):
+        f, amps = self._gate(env8)
+        report = introspect.audit(f, amps, donate=True)
+        assert report.collectives == {"collective-permute": 1}
+        assert report.count("collective-permute") == 1
+        assert report.total == 1
+        # the loose word-regex view is an upper bound on the exact one
+        assert report.matches.get("collective-permute", 0) >= 1
+        assert " collective-permute(" in report.text
+        # cost_analysis is backend-dependent; when present the fields
+        # are numeric
+        if report.flops is not None:
+            assert report.flops >= 0
+        assert isinstance(report.cost, dict)
+        assert isinstance(report.as_dict()["collectives"], dict)
+
+    def test_no_collectives_on_local_fn(self, env8):
+        def f(x):
+            return x * 2.0
+
+        report = introspect.audit(f, jnp.ones((8,)))
+        assert report.collectives == {} and report.total == 0
+
+
+class TestCollectiveBudget:
+    def _hist(self, **h):
+        return {k.replace("_", "-"): v for k, v in h.items()}
+
+    def test_max_budget_passes_and_fails(self):
+        b = introspect.CollectiveBudget(collective_permute=2)
+        b.check(self._hist(collective_permute=2))
+        with pytest.raises(introspect.CollectiveBudgetError):
+            b.check(self._hist(collective_permute=3))
+        # the -start async variant counts against the same family
+        with pytest.raises(introspect.CollectiveBudgetError):
+            b.check({"collective-permute": 2,
+                     "collective-permute-start": 1})
+
+    def test_exact_total_and_allow(self):
+        introspect.CollectiveBudget(
+            exact={"all-reduce": 1}).check({"all-reduce": 1})
+        with pytest.raises(introspect.CollectiveBudgetError):
+            introspect.CollectiveBudget(
+                exact={"all-reduce": 1}).check({"all-reduce": 2})
+        with pytest.raises(introspect.CollectiveBudgetError):
+            introspect.CollectiveBudget(total=1).check(
+                self._hist(all_gather=1, all_reduce=1))
+        introspect.CollectiveBudget(allow=("all-reduce",)).check(
+            {"all-reduce": 4, "all-reduce-start": 1})
+        with pytest.raises(introspect.CollectiveBudgetError):
+            introspect.CollectiveBudget(allow=("all-reduce",)).check(
+                {"all-to-all": 1})
+
+    def test_ambient_budget_checks_audits(self, env8):
+        f, amps = TestAudit()._gate(env8)
+        with introspect.CollectiveBudget(collective_permute=1):
+            introspect.audit(f, amps, donate=True)
+        with pytest.raises(introspect.CollectiveBudgetError):
+            with introspect.CollectiveBudget(total=0):
+                introspect.audit(f, amps, donate=True)
+        # the stack unwinds: audits outside the block are unchecked
+        assert introspect._BUDGET_STACK == []
+        introspect.audit(f, amps, donate=True)
+
+
+# ---------------------------------------------------------------------------
+# Reconciliation + drift injection
+# ---------------------------------------------------------------------------
+
+
+class TestReconciliation:
+    def _drain(self, env):
+        q = _buffered_qureg(env)
+        _ = q.amps
+        return q
+
+    def test_clean_drain_zero_drift_no_log(self, env8, caplog):
+        with caplog.at_level(logging.INFO, logger="quest_tpu.introspect"):
+            self._drain(env8)
+        assert T.counter_total("model_drift_total") == 0
+        assert caplog.records == []
+
+    def test_forced_chunk_override_detected_as_drift(self, env8, caplog):
+        """The acceptance criterion's injected planner-policy
+        perturbation: a forced chunk-count override in the PREDICTION
+        must disagree with the measured chunk key — nonzero drift, one
+        structured log line."""
+        with caplog.at_level(logging.WARNING,
+                             logger="quest_tpu.introspect"):
+            with introspect.perturb_prediction(chunks="4"):
+                self._drain(env8)
+        assert T.counter_value("model_drift_total", kind="chunks") == 1
+        lines = [rec for rec in caplog.records
+                 if rec.name == "quest_tpu.introspect"]
+        assert len(lines) == 1
+        payload = json.loads(lines[0].getMessage())
+        assert payload["event"] == "model_drift"
+        assert payload["kinds"] == ["chunks"]
+        assert payload["drift"]["chunks"]["predicted"] == "4"
+
+    def test_scaled_prediction_drifts_on_count_and_bytes(self, env8,
+                                                         caplog):
+        with caplog.at_level(logging.WARNING,
+                             logger="quest_tpu.introspect"):
+            with introspect.perturb_prediction(scale=2):
+                self._drain(env8)
+        assert T.counter_value("model_drift_total", kind="count") == 1
+        assert T.counter_value("model_drift_total", kind="bytes") == 1
+        lines = [rec for rec in caplog.records
+                 if rec.name == "quest_tpu.introspect"]
+        assert len(lines) == 1  # ONE line per reconciliation, not per kind
+        payload = json.loads(lines[0].getMessage())
+        assert payload["kinds"] == ["bytes", "count"]
+
+    def test_env_var_perturbation(self, env8, monkeypatch):
+        monkeypatch.setenv("QT_INTROSPECT_PERTURB", "scale=3")
+        self._drain(env8)
+        assert T.counter_total("model_drift_total") >= 1
+
+    def test_perf_report_reconciliation_section(self, env8):
+        self._drain(env8)
+        text = T.perf_report()
+        assert "reconciliation (window remaps, predicted vs measured):" \
+            in text
+        assert "cost model holds" in text
+        pred = T.counter_sum("predicted_exchanges_total", op="window_remap")
+        assert f"exchanges: predicted={int(pred)}" in text
+
+    def test_perf_report_flags_drift(self, env8):
+        with introspect.perturb_prediction(scale=2):
+            self._drain(env8)
+        assert "MODEL DRIFT" in T.perf_report()
+
+
+# ---------------------------------------------------------------------------
+# HBM watermark satellite
+# ---------------------------------------------------------------------------
+
+
+class TestWatermark:
+    def test_drain_samples_watermark_gauge(self, env8):
+        q = _buffered_qureg(env8)
+        _ = q.amps
+        peak = T.gauge_max("hbm_watermark_bytes")
+        assert peak is not None and peak > 0
+
+    def test_environment_string_surfaces_peak(self, env8):
+        q = _buffered_qureg(env8)
+        _ = q.amps
+        s = qt.getEnvironmentString(env8)
+        assert f"HbmPeak={int(T.gauge_max('hbm_watermark_bytes'))}" in s
+
+    def test_perf_report_memory_line(self, env8):
+        q = _buffered_qureg(env8)
+        _ = q.amps
+        assert "memory: hbm_watermark_bytes peak=" in T.perf_report()
+
+    def test_gauge_gated_by_mode(self, env8):
+        T.configure("off")
+        q = _buffered_qureg(env8)
+        _ = q.amps
+        T.configure("on")
+        assert T.gauge_max("hbm_watermark_bytes") is None

@@ -33,10 +33,11 @@ import pytest
 
 import oracle
 import quest_tpu as qt
+from quest_tpu import introspect
 from quest_tpu.ops import paulis as OPS_P
 from quest_tpu.parallel import dist as PAR
 
-from test_distributed_hlo import collective_ops
+from test_distributed_hlo import collective_ops  # noqa: F401 - API alias
 
 MESH_SIZES = [2, 4, 8]
 
@@ -116,8 +117,10 @@ class TestTrotterScanSweep:
                 a, codes, angles, mesh=swept_env.mesh, num_qubits=n,
                 rep_qubits=n)
 
-        assert collective_ops(f, amps, donate=True) == {
-            "collective-permute": 2 ** r - 1}
+        # same pin, through the public audit/budget API (introspect)
+        with introspect.CollectiveBudget(
+                exact={"collective-permute": 2 ** r - 1}):
+            introspect.audit(f, amps, donate=True)
 
 
 class TestExpecScanSweep:
@@ -149,11 +152,10 @@ class TestExpecScanSweep:
             return PAR.expec_pauli_sum_scan_sharded(
                 a, codes, coeffs, mesh=swept_env.mesh, num_qubits=n)
 
-        hist = collective_ops(f, amps)
-        permutes = hist.get("collective-permute", 0)
-        reduces = (hist.get("all-reduce", 0)
-                   + hist.get("all-reduce-start", 0))
-        assert permutes == 2 ** r - 1 and reduces == 1, hist
+        report = introspect.audit(f, amps)
+        hist = report.collectives
+        assert report.count("collective-permute") == 2 ** r - 1, hist
+        assert report.count("all-reduce") == 1, hist
         assert set(hist) <= {"collective-permute", "all-reduce",
                              "all-reduce-start"}, hist
 

@@ -248,6 +248,80 @@ class TestPrometheus:
         assert "# TYPE c_seconds histogram" in text
 
 
+class TestPrometheusStrictConformance:
+    """The exposition must parse under a REAL Prometheus text-format
+    parser (prometheus_client), not just our own reader — the regression
+    this pins: non-finite values rendered as Python's ``inf``/``nan``
+    (which Prometheus rejects) instead of ``+Inf``/``-Inf``/``NaN``."""
+
+    @pytest.fixture(autouse=True)
+    def _parser(self):
+        pytest.importorskip("prometheus_client")
+
+    def _families(self):
+        from prometheus_client.parser import text_string_to_metric_families
+
+        return {f.name: f for f in
+                text_string_to_metric_families(T.prometheus_text())}
+
+    def test_full_registry_parses(self):
+        T.inc("exchanges_total", 3, op="remap", chunks="2")
+        T.inc("exchanges_total", 1, op="swap", chunks="1")
+        T.set_gauge("hbm_bytes", 123.0, device='weird"dev\\0')
+        T.observe("lat_seconds", 0.02)
+        T.observe("lat_seconds", 5.0)
+        T.observe("fusion_window_gates", 3)
+        fams = self._families()
+        samples = {(s.name, tuple(sorted(s.labels.items()))): s.value
+                   for f in fams.values() for s in f.samples}
+        assert samples[("exchanges_total",
+                        (("chunks", "2"), ("op", "remap")))] == 3
+        assert samples[("hbm_bytes",
+                        (("device", 'weird"dev\\0'),))] == 123.0
+
+    def test_nonfinite_values_spelled_per_spec(self):
+        T.set_gauge("g_inf", float("inf"), k="a")
+        T.set_gauge("g_ninf", float("-inf"), k="a")
+        T.set_gauge("g_nan", float("nan"), k="a")
+        text = T.prometheus_text()
+        assert 'g_inf{k="a"} +Inf' in text
+        assert 'g_ninf{k="a"} -Inf' in text
+        assert 'g_nan{k="a"} NaN' in text
+        fams = self._families()
+        import math
+
+        vals = {s.metric_name if hasattr(s, "metric_name") else s.name:
+                s.value for f in fams.values() for s in f.samples}
+        assert math.isinf(vals["g_inf"]) and vals["g_inf"] > 0
+        assert math.isinf(vals["g_ninf"]) and vals["g_ninf"] < 0
+        assert math.isnan(vals["g_nan"])
+
+    def test_histogram_semantics_cumulative_and_inclusive(self):
+        """Cumulative le buckets with INCLUSIVE upper bounds, the +Inf
+        bucket equal to _count, and consistent _sum — checked through
+        the real parser's sample view."""
+        bounds = T.HIST_BOUNDS["fusion_window_gates"]
+        T.observe("fusion_window_gates", 1)    # == first bound: inclusive
+        T.observe("fusion_window_gates", 2)    # == second bound: inclusive
+        T.observe("fusion_window_gates", 10_000)  # beyond the last bound
+        fams = self._families()
+        f = fams["fusion_window_gates"]
+        buckets = {s.labels["le"]: s.value for s in f.samples
+                   if s.name == "fusion_window_gates_bucket"}
+        count = next(s.value for s in f.samples
+                     if s.name == "fusion_window_gates_count")
+        total = next(s.value for s in f.samples
+                     if s.name == "fusion_window_gates_sum")
+        assert buckets[repr(float(bounds[0]))] == 1  # le=1 contains v==1
+        assert buckets[repr(float(2))] == 2          # le=2 contains v==2
+        assert buckets["+Inf"] == count == 3
+        assert total == pytest.approx(1 + 2 + 10_000)
+        # cumulative monotone over ascending bounds
+        ordered = [buckets[repr(float(b))] for b in bounds] + \
+            [buckets["+Inf"]]
+        assert ordered == sorted(ordered)
+
+
 # ---------------------------------------------------------------------------
 # The 8-shard dryrun: exchange accounting vs the cost model
 # ---------------------------------------------------------------------------
