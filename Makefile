@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-regress bench docs clean
+.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-regress bench docs clean
 
 all: native
 
@@ -63,6 +63,15 @@ verify-batch:
 # counters, model_drift_total == 0 on the 8-shard dryrun).
 verify-introspect:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_introspect.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Memory-governed execution (docs/design.md §22): HBM budgeting,
+# admission control, spill-to-host eviction, the degradation ladder,
+# and OOM recovery — plus the overhead guard (governed path must cost
+# < 1% over QT_MEM_POLICY=off on a 1k-gate drain).
+verify-governor:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_governor.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -k "Oom or oom" -p no:cacheprovider -p no:xdist -p no:randomly
+	python scripts/bench_governor.py
 
 # Regression gate over the committed BENCH_r*.json trajectory: every
 # normalized metric must stay within 15% of its drift-resistant median

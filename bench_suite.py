@@ -529,8 +529,105 @@ def config10():
         telemetry.configure(prev_mode)
 
 
+def config11():
+    """Budget-constrained A/B (ISSUE 9): the config-10 style alternating
+    local/sharded 2q stream, run once unconstrained and once under a
+    QT_HBM_BUDGET_BYTES pinned just below the unconstrained predicted
+    peak — the memory governor walks its degradation ladder (exchange
+    -chunk bump / program split / spill) and the run must still complete
+    bit-identically.  Dumps the predictor numbers, ladder counters, and
+    both timings (GOVERNOR_snapshot.json, the memory twin of config 8's
+    TELEMETRY_snapshot.json)."""
+    import warnings
+
+    import quest_tpu as qt
+    from quest_tpu import governor, telemetry
+
+    env = qt.createQuESTEnv()
+    n = 13 if CPU else 24
+    depth = 6
+    rng = np.random.default_rng(29)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+
+    def run():
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            for _ in range(depth):
+                qt.multiQubitUnitary(q, [0, 1], u)          # shard-local
+                qt.multiQubitUnitary(q, [n - 2, n - 1], u)  # sharded
+        amps = np.asarray(q.amps)
+        qt.destroyQureg(q, env)
+        return amps
+
+    prev_mode = telemetry.mode_name()
+    telemetry.configure("on")
+    os.environ.pop("QT_HBM_BUDGET_BYTES", None)
+    governor.reset()
+    try:
+        run()  # warm the plan + executor caches
+        t0 = time.perf_counter()
+        want = run()
+        free_s = time.perf_counter() - t0
+
+        # the unconstrained predicted peak for this exact stream
+        os.environ["QT_HBM_BUDGET_BYTES"] = str(1 << 40)
+        governor.reset()
+        q = qt.createQureg(n, env)
+        with qt.gateFusion(q):
+            for _ in range(depth):
+                qt.multiQubitUnitary(q, [0, 1], u)
+                qt.multiQubitUnitary(q, [n - 2, n - 1], u)
+            prediction = governor.explain_memory(q, q._fusion.gates)
+        qt.destroyQureg(q, env)
+
+        budget = prediction["predicted_total_bytes"] - 1
+        os.environ["QT_HBM_BUDGET_BYTES"] = str(budget)
+        governor.reset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            run()  # warm under the constrained config
+            t0 = time.perf_counter()
+            got = run()
+            governed_s = time.perf_counter() - t0
+        identical = bool(np.array_equal(want, got))
+        snap = {
+            "budget_bytes": budget,
+            "prediction": prediction,
+            "bit_identical": identical,
+            "unconstrained_seconds": round(free_s, 5),
+            "governed_seconds": round(governed_s, 5),
+            "degradations": telemetry.snapshot().get("counters", {}).get(
+                "governor_degradations_total", {}),
+            "spills_total": telemetry.counter_total("spills_total"),
+            "spill_bytes_total": telemetry.counter_total(
+                "spill_bytes_total"),
+            "oom_retries_total": telemetry.counter_total(
+                "oom_retries_total"),
+        }
+        path = os.path.abspath("GOVERNOR_snapshot.json")
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1)
+        _set_compile(0.0)  # warmed above under each config
+        _emit(11, f"{n}q budget-constrained governed drain", governed_s,
+              "seconds", governed_s,
+              {"snapshot_file": path,
+               "unconstrained_seconds": round(free_s, 5),
+               "governed_over_unconstrained": round(
+                   governed_s / free_s, 3) if free_s else None,
+               "budget_bytes": budget,
+               "predicted_peak_bytes":
+                   prediction["predicted_peak_bytes"],
+               "bit_identical": identical})
+    finally:
+        os.environ.pop("QT_HBM_BUDGET_BYTES", None)
+        governor.reset()
+        telemetry.configure(prev_mode)
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
 
 
 def main():

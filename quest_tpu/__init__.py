@@ -69,6 +69,8 @@ from .debug import (
 )
 from . import telemetry
 from .telemetry import report_perf as reportPerf, report_perf
+from . import governor
+from .governor import MemoryAdmissionError
 from . import introspect
 from .introspect import (
     explain_circuit,

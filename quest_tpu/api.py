@@ -113,17 +113,27 @@ def invalidQuESTInputError(errMsg: str, errFunc: str):
 
 
 def createQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
-    """Create a state-vector register of numQubits qubits (QuEST.h:529)."""
+    """Create a state-vector register of numQubits qubits (QuEST.h:529).
+    Admission-controlled: with an HBM budget active, a register whose
+    modeled footprint does not fit raises a structured
+    MemoryAdmissionError BEFORE any device allocation (governor.py) —
+    the governed analogue of validateMemoryAllocationSize."""
+    from . import governor as _gov
+
     V.validate_num_qubits(numQubits, "createQureg", num_ranks=env.num_ranks)
     q = Qureg(numQubits, env, is_density_matrix=False)
+    _gov.admit_new(q, "createQureg")
     q.amps = q.device_put(K.init_zero_state(q.num_amps_total, q.dtype))
     return q
 
 
 def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
     """Create a density-matrix register (state-vector of 2N qubits) (QuEST.h:623)."""
+    from . import governor as _gov
+
     V.validate_num_qubits(numQubits, "createDensityQureg", num_ranks=env.num_ranks)
     q = Qureg(numQubits, env, is_density_matrix=True)
+    _gov.admit_new(q, "createDensityQureg")
     q.amps = q.device_put(
         K.init_classical_density(numQubits, 0, q.dtype)
     )
@@ -132,13 +142,19 @@ def createDensityQureg(numQubits: int, env: _env.QuESTEnv) -> Qureg:
 
 def createCloneQureg(qureg: Qureg, env: _env.QuESTEnv) -> Qureg:
     """Create a new register cloning an existing one (QuEST.h:644)."""
+    from . import governor as _gov
+
     q = Qureg(qureg.num_qubits_represented, env, qureg.is_density_matrix)
+    _gov.admit_new(q, "createCloneQureg")
     q.amps = jnp.array(qureg.amps, copy=True)
     return q
 
 
 def destroyQureg(qureg: Qureg, env: Optional[_env.QuESTEnv] = None) -> None:
     """Free a register's amplitude storage (QuEST.h:666)."""
+    from . import governor as _gov
+
+    _gov.release(qureg)
     qureg.amps = None
 
 

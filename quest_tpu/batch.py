@@ -224,6 +224,11 @@ def createBatchedQureg(numQubits: int, env: QuESTEnv, batchSize: int, *,
                           num_ranks=env.num_ranks)
     q = BatchedQureg(numQubits, env, batchSize,
                      is_density_matrix=is_density_matrix, seeds=seeds)
+    # admission is batch-aware: the modeled footprint carries the bank
+    # dimension, so an oversized ensemble is refused before device_put
+    from . import governor as _gov
+
+    _gov.admit_new(q, "createBatchedQureg")
     if is_density_matrix:
         q.amps = K.init_classical_density(numQubits, 0, q.dtype)
     else:

@@ -313,6 +313,21 @@ def get_environment_string(env: QuESTEnv) -> str:
     peak = telemetry.gauge_max("hbm_watermark_bytes")
     if peak is not None:
         s += f" HbmPeak={int(peak)}"
+    # memory-governor surface: policy + budget when active, plus any
+    # spill / OOM-retry history (governor.py; degradations above carry
+    # the per-rung reasons)
+    from . import governor
+
+    if governor.enabled():
+        s += (f" MemGovernor={governor.policy()}"
+              f"(budget={governor.budget_bytes()}"
+              f" resident={governor.resident_bytes()})")
+    spills = telemetry.counter_total("spills_total")
+    if spills:
+        s += f" Spills={int(spills)}"
+    ooms = telemetry.counter_total("oom_retries_total")
+    if ooms:
+        s += f" OomRetries={int(ooms)}"
     s += f" [telemetry: {telemetry.summary()}]"
     return s
 

@@ -96,6 +96,12 @@ def drain(qureg) -> None:
 _PLAN_CACHE_MAX = 64
 _plan_cache: dict = {}
 
+# >0 while a dry-run (explain_circuit's memory section / the governor
+# predictor) is planning: the per-window telemetry observations below
+# are suppressed and nothing is inserted into _plan_cache — the
+# explain contract is NO telemetry mutation (plan_items_quiet)
+_QUIET: List[int] = [0]
+
 
 class ChannelItem:
     """A captured depolarise/damping channel (one-pass elementwise pair
@@ -151,7 +157,8 @@ def _split_items(items, nloc: int, sweep_ok: bool):
 
     def flush_gates():
         if seg:
-            _telemetry.observe("fusion_window_gates", len(seg))
+            if not _QUIET[0]:
+                _telemetry.observe("fusion_window_gates", len(seg))
             ops = C.plan_circuit(list(seg), nloc)
             skeleton, arrs = C.split_plan(ops)
             program.append(("plan", skeleton, len(arrs)))
@@ -203,7 +210,8 @@ def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
     program: List[tuple] = []
     arrays: List[object] = []
     for (i, j), sigma, perm in segments:
-        _telemetry.observe("fusion_remap_window_items", j - i)
+        if not _QUIET[0]:
+            _telemetry.observe("fusion_remap_window_items", j - i)
         if sigma is not None:
             program.append(("remap", sigma))
         sub = []
@@ -256,6 +264,12 @@ def _run(qureg, items) -> None:
     stream.  Per-element gate matrices (a (B, 2, s, s) ``Gate.mat``) are
     planned per element against a shared skeleton and the pass arrays
     enter the program with their own batch axis (vmap in_axes 0)."""
+    from . import governor as _gov
+
+    # a prior degradation ladder may have spilled this register to host
+    # while it sat idle; bring it back BEFORE reading its permutation —
+    # the handle carries the perm the plan must start from
+    _gov.ensure_resident(qureg)
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
@@ -287,6 +301,28 @@ def _run(qureg, items) -> None:
             if len(_plan_cache) >= _PLAN_CACHE_MAX:
                 _plan_cache.pop(next(iter(_plan_cache)))
             _plan_cache[key] = (program, arrays, final_perm)
+    # memory governance: predict this drain's per-device peak and walk
+    # the degradation ladder if it exceeds the budget.  Must run BEFORE
+    # the telemetry/reconcile block and the executor-key resolution so a
+    # chunk escalation is seen consistently by all three (the override
+    # is cleared in the finally).
+    gov = None
+    try:
+        gov = _gov.govern_drain(qureg, program, arrays, nloc=nloc, nsh=nsh)
+        _run_dispatch(qureg, items, program, arrays, gov,
+                      n=n, nsh=nsh, nloc=nloc, bsz=bsz, perm0=perm0,
+                      mats_batched=mats_batched, final_perm=final_perm)
+    finally:
+        _gov.end_drain()
+
+
+def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
+                  bsz, perm0, mats_batched, final_perm) -> None:
+    """Telemetry accounting + dispatch of a planned drain, in (possibly
+    governor-split) program groups, each through the RESOURCE_EXHAUSTED
+    net at the dispatch boundary."""
+    from . import governor as _gov
+
     if _telemetry.enabled():
         _telemetry.inc("fusion_windows_total",
                        sum(1 for p in program if p[0] == "plan"))
@@ -337,23 +373,37 @@ def _run(qureg, items) -> None:
         exchange_key = PAR.exchange_config_key()
     else:
         exchange_key = None
-    runner = _plan_runner(nloc, program,
-                          qureg.env.mesh if nsh else None,
-                          _fused.matmul_precision_name(), exchange_key,
-                          (2 if mats_batched else 1) if bsz else 0)
+    mesh = qureg.env.mesh if nsh else None
+    precision = _fused.matmul_precision_name()
+    batch_flag = (2 if mats_batched else 1) if bsz else 0
     # bypass the amps property (which would re-enter drain); the live
     # permutation the windowed plan leaves behind is carried on the
     # register — the next drain starts from it, the next READ
-    # rematerializes canonical order (Qureg.amps)
-    if nsh:
-        # sharded drains carry the window's exchanges: dispatch under the
-        # collective guard so a dead peer surfaces as ShardLossError and
-        # the resilience layer can fail over (docs/design.md §19)
-        qureg._amps = PAR.guarded_dispatch(
-            runner, qureg._amps, arrays, probs,
-            op="drain", shards=qureg.num_chunks)
-    else:
-        qureg._amps = runner(qureg._amps, arrays, probs)
+    # rematerializes canonical order (Qureg.amps).  The governor's
+    # ladder may have split the program into several dispatch groups
+    # (bit-identical — part boundaries already carry an
+    # optimization_barrier); each group runs through the
+    # RESOURCE_EXHAUSTED net, and sharded groups dispatch under the
+    # collective guard so a dead peer surfaces as ShardLossError and
+    # the resilience layer can fail over (docs/design.md §19)
+    groups = (gov or {}).get("groups") or (program,)
+    ai = pi = 0
+    for gprog in groups:
+        a0, p0 = ai, pi
+        for part in gprog:
+            ai, pi = _part_advance(part, ai, pi)
+        garrays, gprobs = arrays[a0:ai], probs[p0:pi]
+        runner = _plan_runner(nloc, gprog, mesh, precision, exchange_key,
+                              batch_flag)
+        if nsh:
+            def dispatch(r=runner, ga=garrays, gp=gprobs):
+                return PAR.guarded_dispatch(
+                    r, qureg._amps, ga, gp,
+                    op="drain", shards=qureg.num_chunks)
+        else:
+            def dispatch(r=runner, ga=garrays, gp=gprobs):
+                return r(qureg._amps, ga, gp)
+        qureg._amps = _gov.oom_net(dispatch, qureg)
     if nsh:
         if final_perm is not None and list(final_perm) != list(range(n)):
             qureg._perm = tuple(final_perm)
@@ -394,6 +444,61 @@ def _plan_batched_items(items, bsz: int, n: int, nloc: int, nsh: int,
         np.stack([np.asarray(per_elem[b][j]) for b in range(bsz)])
         for j in range(len(per_elem[0])))
     return program, arrays, final_perm
+
+
+def _part_advance(part, ai: int, pi: int):
+    """Walk the (pass-array, channel-probability) offsets past one
+    program part — shared by the compiled executor and the governor's
+    grouped-dispatch split, so both slice the argument streams
+    identically."""
+    if part[0] == "plan":
+        return ai + part[2], pi
+    if part[0] == "chansweep":
+        return ai, pi + len(part[1])
+    if part[0] == "remap":
+        return ai, pi
+    return ai, pi + 1
+
+
+def plan_items_quiet(qureg, items):
+    """Plan ``items`` exactly as _run would — same program parts, pass
+    arrays, and final permutation — WITHOUT touching telemetry or the
+    plan cache: the dry-run planning path behind explain_circuit's
+    ``memory`` section and the governor predictor.  A cached plan is
+    read (identical values), but a miss is NOT inserted — explaining a
+    circuit must not flip the cache status the introspection tests pin.
+    Returns (program, arrays, final_perm, nloc, nsh)."""
+    n = qureg.num_qubits_in_state_vec
+    nsh = _shard_bits(qureg)
+    nloc = n - nsh
+    bsz = int(getattr(qureg, "batch_size", 0) or 0)
+    mats_batched = bool(bsz) and any(
+        not isinstance(it, ChannelItem) and getattr(it.mat, "ndim", 0) == 4
+        for it in items)
+    from .ops import fused as _fusedmod
+    sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
+    perm0 = qureg._perm if nsh else None
+    if not items:
+        return (), (), None, nloc, nsh
+    key = _plan_key(items, nloc, sweep_ok, perm0)
+    hit = _plan_cache.get(key) if key is not None else None
+    if hit is not None:
+        program, arrays, final_perm = hit
+        return program, arrays, final_perm, nloc, nsh
+    _QUIET[0] += 1
+    try:
+        if mats_batched:
+            program, arrays, final_perm = _plan_batched_items(
+                items, bsz, n, nloc, nsh, perm0, sweep_ok)
+        elif nsh:
+            program, arrays, final_perm = _split_items_sharded(
+                items, n, nloc, perm0, sweep_ok)
+        else:
+            program, arrays = _split_items(items, nloc, sweep_ok)
+            final_perm = None
+    finally:
+        _QUIET[0] -= 1
+    return program, arrays, final_perm, nloc, nsh
 
 
 @lru_cache(maxsize=256)
@@ -453,20 +558,11 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
                 amps, kind, probs[pi], nn=nloc, t=t, b=b)
         return amps
 
-    def _advance(part, ai, pi):
-        if part[0] == "plan":
-            return ai + part[2], pi
-        if part[0] == "chansweep":
-            return ai, pi + len(part[1])
-        if part[0] == "remap":
-            return ai, pi
-        return ai, pi + 1
-
     def _apply(amps, arrays, probs):
         ai = pi = 0
         for part in program:
             amps = _apply_part(part, amps, arrays, probs, ai, pi)
-            ai, pi = _advance(part, ai, pi)
+            ai, pi = _part_advance(part, ai, pi)
             # without this barrier XLA:TPU's memory assignment keeps every
             # part's temporaries live to the end of the program (measured:
             # +1.25 GiB PER CHANNEL at 13q rho -> 21 GiB OOM; flat 1.75 GiB
@@ -485,7 +581,7 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
                 amps = jax.vmap(
                     step, in_axes=(0, 0 if batch == 2 else None, None)
                 )(amps, arrays, probs)
-                ai, pi = _advance(part, ai, pi)
+                ai, pi = _part_advance(part, ai, pi)
                 amps = jax.lax.optimization_barrier(amps)
             return amps
     else:

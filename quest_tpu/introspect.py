@@ -238,12 +238,20 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
 
     read_exch = final_remap["exchanges"] if final_remap else 0
     read_bytes = final_remap["exchange_bytes"] if final_remap else 0
+    # predicted per-device footprint of draining this stream — the
+    # governor's analytic model (state x live-copy multiplier + pass
+    # arrays, docs/design.md §22) over the EXACT program the drain
+    # would dispatch, planned quietly (no telemetry, no cache insert)
+    from . import governor as _gov
+
+    memory = _gov.explain_memory(qureg, items)
     return ExplainReport(
         register=register,
         items=len(items),
         windows=windows,
         final_remap=final_remap,
         plan=plan,
+        memory=memory,
         totals={
             "windows": len(windows),
             "plan_windows": int(plan_windows),
@@ -298,6 +306,18 @@ def format_explain(report: dict) -> str:
         + (f" (+{t['exchanges_with_read'] - t['exchanges']} exch / "
            f"+{t['exchange_bytes_with_read'] - t['exchange_bytes']} bytes "
            f"at read)" if report["final_remap"] else ""))
+    mem = report.get("memory")
+    if mem:
+        line = (f"memory: peak/device={mem['predicted_peak_bytes']} "
+                f"(state={mem['state_bytes_per_device']} "
+                f"x{mem['live_multiplier']:.2f} + "
+                f"arrays={mem['pass_array_bytes']}), "
+                f"resident_other={mem['other_resident_bytes']}")
+        if mem["budget_bytes"] is not None:
+            line += (f", budget={mem['budget_bytes']} "
+                     f"policy={mem['policy']} "
+                     f"fits={'yes' if mem['fits'] else 'NO'}")
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -242,13 +242,27 @@ _TARGET_CHUNK_BYTES = 1 << 22
 MAX_EXCHANGE_CHUNKS = 8
 
 
+# per-drain chunk escalation set by the memory governor's degradation
+# ladder (governor.govern_drain rung 1) and cleared in the drain's
+# finally (governor.end_drain) — published through exchange_config_key
+# so the compiled-executor cache, the telemetry byte accounting, and
+# the reconcile prediction all see ONE consistent chunk policy.  The
+# explicit QT_EXCHANGE_CHUNKS env override always wins.
+_GOVERNOR_CHUNKS: list = [None]
+
+
 def exchange_config_key() -> Optional[str]:
-    """The live ``QT_EXCHANGE_CHUNKS`` override — a cache-key component
-    for programs that bake the chunk count in at trace time
+    """The live chunk-policy override — a cache-key component for
+    programs that bake the chunk count in at trace time
     (fusion._plan_runner keys its compiled drain executor on this, so
     flipping the env var between drains retraces instead of silently
-    reusing a stale chunk schedule)."""
-    return os.environ.get(_EXCHANGE_ENV)
+    reusing a stale chunk schedule).  ``QT_EXCHANGE_CHUNKS`` first,
+    then the memory governor's per-drain escalation."""
+    v = os.environ.get(_EXCHANGE_ENV)
+    if v is not None:
+        return v
+    g = _GOVERNOR_CHUNKS[0]
+    return None if g is None else str(int(g))
 
 
 def _pow2_floor(x: int) -> int:

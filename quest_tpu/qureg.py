@@ -47,6 +47,10 @@ class Qureg:
         self.qasm_log = QASMLogger(num_qubits)
         self._amps: Optional[jax.Array] = None
         self._fusion = None  # FusionBuffer while a gateFusion context is active
+        # governor.SpillHandle while the amplitudes live on host (the
+        # memory governor's spill-to-host eviction); restored lazily on
+        # the next touch via the amps getters below
+        self._spill = None
         # live logical->physical qubit permutation of a SHARDED register
         # (None = canonical order).  _perm[q] = physical state-vector bit
         # holding logical bit q: the communication-avoiding scheduler keeps
@@ -85,12 +89,13 @@ class Qureg:
         batched remap — so every reader (calculations, measurement,
         checkpointing, host gathers) sees reference semantics."""
         if self._amps is None:
-            from . import validation
+            from . import governor, validation
 
-            raise validation.QuESTError(
-                "Qureg: the register has been destroyed (destroyQureg) or "
-                "never initialised."
-            )
+            if not governor.restore_register(self):
+                raise validation.QuESTError(
+                    "Qureg: the register has been destroyed (destroyQureg) "
+                    "or never initialised."
+                )
         if self._fusion is not None and self._fusion.gates:
             from . import fusion
 
@@ -115,6 +120,7 @@ class Qureg:
         # external overwrites are canonical-order by contract; only the
         # perm-aware writers (_set_amps_permuted) carry a permutation over
         self._perm = None
+        self._spill = None  # an overwrite invalidates any host snapshot
         self._amps = value
 
     def _amps_raw(self) -> jax.Array:
@@ -122,7 +128,10 @@ class Qureg:
         perm-aware dispatch path's read (pending fused gates still drain
         first so operation order is preserved)."""
         if self._amps is None:
-            return self.amps  # raises the destroyed-register error
+            from . import governor
+
+            if not governor.restore_register(self):
+                return self.amps  # raises the destroyed-register error
         if self._fusion is not None and self._fusion.gates:
             from . import fusion
 
@@ -133,6 +142,7 @@ class Qureg:
         """Rebind amplitudes held under logical->physical ``perm``
         (identity or None -> canonical).  Unlike the ``amps`` setter this
         PRESERVES the lazy-permutation bookkeeping."""
+        self._spill = None
         self._amps = value
         if perm is not None and tuple(perm) == tuple(
                 range(self.num_qubits_in_state_vec)):

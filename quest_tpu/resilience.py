@@ -196,12 +196,18 @@ class FaultPlan:
     - ``shard_loss@W`` a shard dies during window W's exchange dispatch:
                       the guard raises dist.ShardLossError and
                       run_resumable fails over (rollback + mesh shrink)
+    - ``oom@W``       window W's drain dispatch raises a synthetic
+                      RESOURCE_EXHAUSTED once — caught by the memory
+                      governor's OOM net (governor.oom_net), which
+                      evicts idle registers, clears the plan caches,
+                      and retries; arming ``oom@W`` TWICE exhausts the
+                      single retry and proves the net re-raises
 
     Every fired event is appended to :attr:`log` so tests can assert the
     plan actually executed."""
 
     _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale",
-              "stall", "shard_loss")
+              "stall", "shard_loss", "oom")
 
     def __init__(self, spec: str = ""):
         self.events: List[Tuple[str, int]] = []
@@ -215,6 +221,7 @@ class FaultPlan:
         # scope
         self._stalls_pending = 0
         self._loss_pending = False
+        self._oom_pending = 0
         spec = (spec or "").strip()
         if spec:
             for part in spec.split(","):
@@ -260,12 +267,20 @@ class FaultPlan:
         return self._fire("corrupt", window)
 
     def arm_exchange_window(self, window: int) -> None:
-        """Move this window's ``stall``/``shard_loss`` events into the
-        pending slots the exchange-dispatch hook consumes."""
+        """Move this window's ``stall``/``shard_loss``/``oom`` events
+        into the pending slots the dispatch-time hooks consume."""
         if self._fire("stall", window):
             self._stalls_pending += 1
         if self._fire("shard_loss", window):
             self._loss_pending = True
+        self.arm_oom(window)
+
+    def arm_oom(self, window: int) -> None:
+        """Move window W's ``oom`` events into the pending slot
+        governor.oom_net consumes.  Called by arm_exchange_window under
+        run_resumable; a bare fusion drain arms window 0 itself."""
+        while self._fire("oom", window):
+            self._oom_pending += 1
 
     def take_exchange_fault(self, op: str) -> Optional[str]:
         """The dist.EXCHANGE_FAULT_HOOK body: one pending fault per
@@ -277,6 +292,17 @@ class FaultPlan:
             self._stalls_pending -= 1
             return "stall"
         return None
+
+    def take_oom_fault(self) -> bool:
+        """governor.oom_net's injection hook: one synthetic
+        RESOURCE_EXHAUSTED per pending ``oom`` event, consumed once per
+        dispatch ATTEMPT — so a single armed event makes the net's one
+        retry succeed, while two pending events burn the retry too and
+        the failure propagates (the exhaustion path the tests pin)."""
+        if self._oom_pending > 0:
+            self._oom_pending -= 1
+            return True
+        return False
 
     def take_io_fault(self) -> bool:
         if self.io_budget > 0:

@@ -525,6 +525,12 @@ def perf_report(env=None) -> str:
     if peak is not None:
         lines.append(f"memory: hbm_watermark_bytes peak={_num(peak)} "
                      f"({peak / (1 << 20):.1f} MiB)")
+    # memory-governor status (budget, residency, spill/OOM history)
+    from . import governor as _governor
+
+    gov_line = _governor.summary_line()
+    if gov_line:
+        lines.append(gov_line)
     return "\n".join(lines)
 
 

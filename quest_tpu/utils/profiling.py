@@ -10,6 +10,7 @@ circuit phases inside a trace.
 from __future__ import annotations
 
 import contextlib
+import sys
 import time
 from typing import Iterator, Optional
 
@@ -57,6 +58,21 @@ def timed(label: str, sync: Optional[object] = None) -> Iterator[dict]:
         _telemetry.observe("timed_seconds", out["seconds"], label=label)
 
 
+def _maxrss_bytes(res=None, platform: Optional[str] = None) -> int:
+    """Host process peak RSS in BYTES.  ``getrusage`` reports
+    ``ru_maxrss`` in kilobytes on Linux but in bytes on macOS (the BSD
+    heritage, see getrusage(2) on each) — scaling unconditionally by
+    1024 inflated the Darwin watermark 1024x.  ``res``/``platform``
+    default to the live ``resource`` module and ``sys.platform`` and
+    exist so tests can pin both branches."""
+    if res is None:
+        import resource as res
+    if platform is None:
+        platform = sys.platform
+    scale = 1 if platform.startswith("darwin") else 1024
+    return int(res.getrusage(res.RUSAGE_SELF).ru_maxrss) * scale
+
+
 def memory_watermark() -> dict:
     """Per-device HBM statistics: ``{device: memory_stats() dict}`` via
     ``jax.local_devices()[i].memory_stats()``, with a graceful fallback
@@ -66,8 +82,11 @@ def memory_watermark() -> dict:
     the consolidated ``hbm_watermark_bytes{device}`` the fusion drain
     samples at window boundaries — peak surfaced in
     getEnvironmentString and reportPerf).  When NO device exposes
-    memory_stats (the CPU backend), the host process max-RSS stands in
-    under ``device="host"`` so the watermark loop stays testable."""
+    memory_stats (the CPU backend), the memory governor's modeled
+    per-device peak stands in under ``device="model"`` when a budget is
+    active (so the CPU dryrun's watermark agrees with the predictor —
+    the explain/reconcile contract), and the host process max-RSS under
+    ``device="host"`` otherwise so the watermark loop stays testable."""
     out: dict = {}
     saw_device_stats = False
     for d in jax.local_devices():
@@ -89,11 +108,17 @@ def memory_watermark() -> dict:
             _telemetry.set_gauge("hbm_watermark_bytes", peak,
                                  device=str(d))
     if not saw_device_stats:
-        try:
-            import resource
+        from .. import governor as _governor
 
-            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
-            _telemetry.set_gauge("hbm_watermark_bytes", rss, device="host")
-        except Exception:  # pragma: no cover - non-POSIX host
-            pass
+        modeled = _governor.modeled_watermark_bytes()
+        if modeled is not None:
+            out["model"] = {"modeled_peak_bytes_in_use": int(modeled)}
+            _telemetry.set_gauge("hbm_watermark_bytes", modeled,
+                                 device="model")
+        else:
+            try:
+                _telemetry.set_gauge("hbm_watermark_bytes", _maxrss_bytes(),
+                                     device="host")
+            except Exception:  # pragma: no cover - non-POSIX host
+                pass
     return out

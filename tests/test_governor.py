@@ -1,0 +1,410 @@
+"""Memory-governed execution suite (quest_tpu/governor.py, ISSUE 9).
+
+Covers the acceptance contract:
+  * admission control — createQureg / createDensityQureg /
+    createBatchedQureg are refused up front with a structured
+    MemoryAdmissionError naming predicted vs available bytes when the
+    register cannot fit under the per-device HBM budget;
+  * the analytic drain predictor (explain_circuit's ``memory`` section)
+    agrees with the measured ``hbm_watermark_bytes`` peak on the
+    8-shard dryrun within 10%;
+  * spill-to-host eviction round-trips bit-identically — amplitudes,
+    live permutation, and the batched measurement-key bank — including
+    a spilled register transparently restored inside run_resumable;
+  * the pinned degradation-ladder scenario: a budget just below the
+    unconstrained peak makes the drain degrade visibly (exchange-chunk
+    bump / program split / spill, counted in
+    governor_degradations_total) while completing bit-identically,
+    and QT_MEM_POLICY=strict raises instead — before any dispatch;
+  * the ru_maxrss platform fix: kilobytes on Linux, bytes on Darwin.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import governor as G
+from quest_tpu import resilience as R
+from quest_tpu import telemetry as T
+from quest_tpu.parallel import dist as PAR
+from quest_tpu.utils import profiling
+
+
+U2 = np.linalg.qr(np.random.default_rng(11).normal(size=(4, 4)))[0]
+U2_SOA = np.stack([U2, np.zeros_like(U2)])
+
+NBIG = 13  # 16 KiB per device on the 8-way dryrun mesh (f64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_governor(monkeypatch):
+    """Each test starts with an empty ledger, no budget, default policy,
+    and no leftover governor chunk override; degradation warnings from
+    the ladder are expected, so they are not treated as errors."""
+    monkeypatch.delenv("QT_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.delenv("QT_MEM_POLICY", raising=False)
+    monkeypatch.delenv("QT_EXCHANGE_CHUNKS", raising=False)
+    monkeypatch.setenv("QT_RETRY_BASE_SECONDS", "0.001")
+    G.reset()
+    for k in list(R.DEGRADATIONS):
+        if k.startswith("memory_governor"):
+            R.DEGRADATIONS.pop(k)
+    yield
+    G.reset()
+
+
+def _big_workload(q):
+    """Two windows: a local gate, then a gate on the sharded top qubits
+    forcing a remap exchange (the transient the chunk rung shrinks)."""
+    with qt.gateFusion(q):
+        qt.multiQubitUnitary(q, [0, 1], U2)
+        qt.multiQubitUnitary(q, [NBIG - 2, NBIG - 1], U2)
+
+
+def _predict(env, budget=1 << 40):
+    """The unconstrained predictor numbers for _big_workload."""
+    import os
+
+    os.environ["QT_HBM_BUDGET_BYTES"] = str(budget)
+    try:
+        G.reset()
+        q = qt.createQureg(NBIG, env)
+        with qt.gateFusion(q):
+            qt.multiQubitUnitary(q, [0, 1], U2)
+            qt.multiQubitUnitary(q, [NBIG - 2, NBIG - 1], U2)
+            rep = qt.explain_circuit(q)
+        mem = rep["memory"]
+        amps = np.asarray(q.amps)
+        qt.destroyQureg(q, env)
+        return mem, amps
+    finally:
+        del os.environ["QT_HBM_BUDGET_BYTES"]
+        G.reset()
+
+
+class TestAdmission:
+    def test_within_budget_admits_and_tracks(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        assert G.resident_bytes() == G.register_bytes_per_device(q) == 16384
+        qt.destroyQureg(q, env)
+        assert G.resident_bytes() == 0
+
+    def test_reject_math_and_error_attrs(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", "8192")
+        with pytest.raises(qt.MemoryAdmissionError) as ei:
+            qt.createQureg(NBIG, env)
+        e = ei.value
+        assert e.predicted_bytes == 16384
+        assert e.available_bytes == 8192
+        assert e.budget_bytes == 8192
+        assert "createQureg" in str(e)
+        assert "16384" in str(e) and "8192" in str(e)
+        assert T.counter_total("admission_rejects_total") >= 1
+
+    def test_reject_accounts_for_resident_registers(self, env, monkeypatch):
+        # two big registers fit alone but not together
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(16384 + 1024))
+        q1 = qt.createQureg(NBIG, env)
+        with pytest.raises(qt.MemoryAdmissionError) as ei:
+            qt.createQureg(NBIG, env)
+        assert ei.value.available_bytes == 1024  # budget minus q1
+        qt.destroyQureg(q1, env)
+        q2 = qt.createQureg(NBIG, env)  # admitted once q1 is released
+        qt.destroyQureg(q2, env)
+
+    def test_density_admission(self, env, monkeypatch):
+        # a 7-qubit density matrix is a 14-qubit register
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", "16384")
+        with pytest.raises(qt.MemoryAdmissionError) as ei:
+            qt.createDensityQureg(7, env)
+        assert "createDensityQureg" in str(ei.value)
+        assert ei.value.predicted_bytes == 32768
+
+    def test_batched_admission_scales_with_batch(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(3 * 16384))
+        b = qt.createBatchedQureg(NBIG, env, 3)  # exactly fits
+        assert G.register_bytes_per_device(b) == 3 * 16384
+        G.release(b)
+        with pytest.raises(qt.MemoryAdmissionError) as ei:
+            qt.createBatchedQureg(NBIG, env, 4)
+        assert "createBatchedQureg" in str(ei.value)
+        assert ei.value.predicted_bytes == 4 * 16384
+
+    def test_no_budget_means_inert(self, env):
+        assert not G.enabled()
+        q = qt.createQureg(NBIG, env)  # no budget -> nothing refused
+        qt.destroyQureg(q, env)
+
+    def test_policy_off_disables_even_with_budget(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", "64")
+        monkeypatch.setenv("QT_MEM_POLICY", "off")
+        q = qt.createQureg(NBIG, env)
+        qt.destroyQureg(q, env)
+
+
+class TestPredictor:
+    def test_explain_memory_section_shape(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        with qt.gateFusion(q):
+            qt.multiQubitUnitary(q, [0, 1], U2)
+            qt.multiQubitUnitary(q, [NBIG - 2, NBIG - 1], U2)
+            rep = qt.explain_circuit(q)
+        mem = rep["memory"]
+        for key in ("policy", "budget_bytes", "state_bytes_per_device",
+                    "pass_array_bytes", "live_multiplier",
+                    "exchange_chunks", "predicted_peak_bytes",
+                    "other_resident_bytes", "predicted_total_bytes",
+                    "headroom_bytes", "fits"):
+            assert key in mem, key
+        assert mem["state_bytes_per_device"] == 16384
+        assert mem["fits"] is True
+        assert mem["predicted_peak_bytes"] >= mem["state_bytes_per_device"]
+        assert "memory:" in rep.table()
+        qt.destroyQureg(q, env)
+
+    def test_predictor_matches_measured_watermark(self, env, monkeypatch):
+        """Acceptance: explain_circuit's predicted peak agrees with the
+        measured hbm_watermark_bytes peak within 10% on the 8-shard
+        dryrun (the model gauge stands in for device memory_stats on
+        CPU)."""
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        with qt.gateFusion(q):
+            qt.multiQubitUnitary(q, [0, 1], U2)
+            qt.multiQubitUnitary(q, [NBIG - 2, NBIG - 1], U2)
+            rep = qt.explain_circuit(q)
+        predicted = rep["memory"]["predicted_total_bytes"]
+        # the context exit above ran the drain -> usage was recorded
+        wm = profiling.memory_watermark()
+        assert "model" in wm
+        measured = wm["model"]["modeled_peak_bytes_in_use"]
+        assert measured == G.modeled_watermark_bytes()
+        assert abs(predicted - measured) <= 0.10 * measured
+        gauges = T.snapshot().get("gauges", {})
+        assert gauges.get("hbm_watermark_bytes", {}).get(
+            "device=model") == measured
+        qt.destroyQureg(q, env)
+
+    def test_explain_is_side_effect_free(self, env, monkeypatch):
+        """The memory section must not touch telemetry counters or the
+        fusion plan cache (the pinned explain contract)."""
+        from quest_tpu import fusion
+
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        fusion._plan_cache.clear()
+        with qt.gateFusion(q):
+            qt.multiQubitUnitary(q, [0, 1], U2)
+            qt.multiQubitUnitary(q, [NBIG - 2, NBIG - 1], U2)
+            before_counters = dict(T.snapshot().get("counters", {}))
+            qt.explain_circuit(q)
+            assert len(fusion._plan_cache) == 0
+            after_counters = dict(T.snapshot().get("counters", {}))
+            assert after_counters == before_counters
+        qt.destroyQureg(q, env)
+
+
+class TestSpill:
+    def test_spill_restore_amps_and_perm(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        _big_workload(q)  # leaves a live logical->physical permutation
+        amps0 = np.asarray(q.amps)
+        perm0 = tuple(q._perm) if q._perm is not None else None
+        assert G.spill_register(q) == 16384
+        assert q._amps is None
+        assert T.counter_total("spills_total") >= 1
+        assert T.counter_total("spill_bytes_total") >= 2 * (1 << NBIG) * 8
+        # first touch restores lazily, bit-identically
+        amps1 = np.asarray(q.amps)
+        np.testing.assert_array_equal(amps0, amps1)
+        perm1 = tuple(q._perm) if q._perm is not None else None
+        assert perm0 == perm1
+        assert T.counter_total("spill_restores_total") >= 1
+        qt.destroyQureg(q, env)
+
+    def test_spill_preserves_batched_key_bank(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        b = qt.createBatchedQureg(6, env, 3)
+        qt.applyBatchedUnitary(b, [0], np.stack(
+            [np.stack([np.eye(2), np.zeros((2, 2))])] * 3))
+        qt.measureBatched(b, 0)  # advance the per-element key bank
+        keys0 = np.asarray(b.key_state())
+        amps0 = np.asarray(b.amps)
+        assert G.spill_register(b) > 0
+        np.testing.assert_array_equal(np.asarray(b.amps), amps0)
+        np.testing.assert_array_equal(np.asarray(b.key_state()), keys0)
+
+    def test_destroyed_register_still_raises(self, env):
+        q = qt.createQureg(5, env)
+        qt.destroyQureg(q, env)
+        with pytest.raises(qt.QuESTError, match="destroyed"):
+            _ = q.amps
+
+    def test_spilled_register_resumes_via_run_resumable(
+            self, env, tmp_path, monkeypatch):
+        """A register spilled to host is transparently restored when
+        run_resumable touches it — the resumed stream is bit-identical
+        to the never-spilled run."""
+        from quest_tpu import circuit as CIRC
+
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        gates = [CIRC.Gate((0, 1), U2_SOA), CIRC.Gate((4, 5), U2_SOA),
+                 CIRC.Gate((2, 3), U2_SOA), CIRC.Gate((0, 5), U2_SOA)]
+
+        qt.seedQuEST(env, [3])
+        ref = qt.createQureg(6, env)
+        qt.run_resumable(ref, gates, str(tmp_path / "ref"), every=2)
+        want = np.asarray(ref.amps)
+        qt.destroyQureg(ref, env)
+
+        qt.seedQuEST(env, [3])
+        q = qt.createQureg(6, env)
+        assert G.spill_register(q) > 0
+        assert q._amps is None
+        qt.run_resumable(q, gates, str(tmp_path / "spilled"), every=2)
+        np.testing.assert_array_equal(np.asarray(q.amps), want)
+        qt.destroyQureg(q, env)
+
+
+class TestDegradationLadder:
+    def test_chunk_bump_completes_bit_identically(self, env, monkeypatch):
+        """Pinned scenario: QT_HBM_BUDGET_BYTES one byte below the
+        unconstrained predicted peak -> the drain visibly degrades
+        (exchange-chunk bump counted in governor_degradations_total)
+        and still completes bit-identically."""
+        mem, want = _predict(env)
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES",
+                           str(mem["predicted_total_bytes"] - 1))
+        before = T.counter_total("governor_degradations_total")
+        q = qt.createQureg(NBIG, env)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _big_workload(q)
+        np.testing.assert_array_equal(np.asarray(q.amps), want)
+        assert T.counter_total("governor_degradations_total") > before
+        snap = T.snapshot()["counters"]["governor_degradations_total"]
+        assert any("chunks" in k or "split" in k for k in snap)
+        assert any(k.startswith("memory_governor")
+                   for k in qt.degradation_report())
+        # the override is cleared once the drain ends
+        assert PAR._GOVERNOR_CHUNKS[0] is None
+        qt.destroyQureg(q, env)
+
+    def test_spill_rung_evicts_idle_register(self, env, monkeypatch):
+        """When shrinking transients cannot make the drain fit, the
+        ladder spills LRU-idle registers to host; the spilled register
+        restores bit-identically afterwards."""
+        idle = qt.createQureg(NBIG, env)
+        idle_amps = np.asarray(idle.amps)
+        active = qt.createQureg(6, env)
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES",
+                           str(G.register_bytes_per_device(idle)))
+        spills0 = T.counter_total("spills_total")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with qt.gateFusion(active):
+                qt.multiQubitUnitary(active, [0, 1], U2)
+        assert T.counter_total("spills_total") > spills0
+        assert idle._amps is None  # evicted
+        monkeypatch.delenv("QT_HBM_BUDGET_BYTES")
+        np.testing.assert_array_equal(np.asarray(idle.amps), idle_amps)
+        qt.destroyQureg(idle, env)
+        qt.destroyQureg(active, env)
+
+    def test_strict_raises_before_dispatch(self, env, monkeypatch):
+        """QT_MEM_POLICY=strict refuses the drain with a structured
+        error naming predicted vs available bytes instead of degrading.
+        Nothing was dispatched: the gates stay queued, and lifting the
+        budget lets the SAME drain complete bit-identically."""
+        mem, want = _predict(env)
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES",
+                           str(mem["predicted_total_bytes"] - 1))
+        monkeypatch.setenv("QT_MEM_POLICY", "strict")
+        rejects0 = T.counter_total("admission_rejects_total")
+        q = qt.createQureg(NBIG, env)
+        with pytest.raises(qt.MemoryAdmissionError) as ei:
+            _big_workload(q)
+        e = ei.value
+        assert e.predicted_bytes == mem["predicted_total_bytes"]
+        assert e.available_bytes == mem["predicted_total_bytes"] - 1
+        assert str(e.predicted_bytes) in str(e)
+        assert T.counter_total("admission_rejects_total") > rejects0
+        # the refused gates are still queued; with the constraint lifted
+        # the drain proceeds and matches the unconstrained run
+        monkeypatch.delenv("QT_HBM_BUDGET_BYTES")
+        monkeypatch.delenv("QT_MEM_POLICY")
+        np.testing.assert_array_equal(np.asarray(q.amps), want)
+        qt.destroyQureg(q, env)
+
+    def test_env_chunk_override_wins_over_ladder(self, env, monkeypatch):
+        """An explicit QT_EXCHANGE_CHUNKS pin is operator intent — the
+        ladder must not silently fight it (it skips the chunk rung and
+        goes straight to splitting/spilling)."""
+        mem, want = _predict(env)
+        monkeypatch.setenv("QT_EXCHANGE_CHUNKS", "1")
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES",
+                           str(mem["predicted_total_bytes"] - 1))
+        chunks0 = T.counter_value("governor_degradations_total",
+                                  rung="chunks")
+        q = qt.createQureg(NBIG, env)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _big_workload(q)
+        np.testing.assert_array_equal(np.asarray(q.amps), want)
+        assert T.counter_value("governor_degradations_total",
+                               rung="chunks") == chunks0
+        qt.destroyQureg(q, env)
+
+
+class TestMaxRss:
+    """Satellite: ru_maxrss is kilobytes on Linux but BYTES on macOS —
+    the old unconditional *1024 inflated Darwin watermarks 1024x."""
+
+    class _FakeResource:
+        RUSAGE_SELF = 0
+
+        class _Usage:
+            ru_maxrss = 2048
+
+        @classmethod
+        def getrusage(cls, _who):
+            return cls._Usage()
+
+    def test_linux_scales_kilobytes(self):
+        assert profiling._maxrss_bytes(
+            res=self._FakeResource, platform="linux") == 2048 * 1024
+
+    def test_darwin_reports_bytes(self):
+        assert profiling._maxrss_bytes(
+            res=self._FakeResource, platform="darwin") == 2048
+
+    def test_live_platform_positive(self):
+        assert profiling._maxrss_bytes() > 0
+
+
+class TestSurfaces:
+    def test_environment_string_reports_governor(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        s = qt.getEnvironmentString(env)
+        assert "MemGovernor=degrade" in s
+        assert str(1 << 30) in s
+
+    def test_perf_report_summary_line(self, env, monkeypatch):
+        monkeypatch.setenv("QT_HBM_BUDGET_BYTES", str(1 << 30))
+        q = qt.createQureg(NBIG, env)
+        _big_workload(q)
+        line = G.summary_line()
+        assert line is not None and "governor" in line
+        assert line in T.perf_report()
+        qt.destroyQureg(q, env)
+
+    def test_invalid_policy_degrades_to_default(self, env, monkeypatch):
+        monkeypatch.setenv("QT_MEM_POLICY", "aggressive")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert G.policy() == "degrade"

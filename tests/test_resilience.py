@@ -415,6 +415,76 @@ class TestFaultPlanParsing:
         assert plan.take_exchange_fault("drain") is None
         assert plan.log == ["stall@1", "shard_loss@1"]
 
+    def test_oom_kind_parses_and_arms(self):
+        plan = qt.FaultPlan("oom@2")
+        assert ("oom", 2) in plan.events
+        assert not plan.take_oom_fault()  # not armed yet
+        plan.arm_exchange_window(2)
+        assert plan.take_oom_fault()  # one event -> one synthetic OOM
+        assert not plan.take_oom_fault()
+        assert plan.log == ["oom@2"]
+
+
+class TestOomNet:
+    """oom@W: the memory governor's RESOURCE_EXHAUSTED net (ISSUE 9).
+    One armed event makes a window's drain dispatch fail once — the net
+    evicts idle registers, clears the plan caches, and retries; arming
+    the SAME window twice burns the single retry and the failure
+    propagates."""
+
+    def test_evict_and_retry_fires_exactly_once(self, env, tmp_path,
+                                                reference):
+        from quest_tpu import telemetry as T
+
+        q = _fresh(env)
+        plan = qt.FaultPlan("oom@2")
+        before = T.counter_total("oom_retries_total")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qt.run_resumable(q, _circuit(), str(tmp_path), every=8,
+                             faults=plan)
+        assert plan.log.count("oom@2") == 1
+        assert T.counter_total("oom_retries_total") == before + 1
+        np.testing.assert_array_equal(np.asarray(q.amps), reference)
+
+    def test_exhaustion_reraises(self, env, tmp_path):
+        from quest_tpu import telemetry as T
+
+        q = _fresh(env)
+        plan = qt.FaultPlan("oom@2,oom@2")
+        before = T.counter_total("oom_retries_total")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                qt.run_resumable(q, _circuit(), str(tmp_path), every=8,
+                                 faults=plan)
+        assert T.counter_total("oom_retries_total") == before + 1
+
+    def test_plain_drain_arms_window_zero(self, env):
+        """A gateFusion drain outside run_resumable counts as window 0,
+        so oom@0 exercises the net without the checkpoint machinery."""
+        from quest_tpu import telemetry as T
+
+        u = np.linalg.qr(np.random.default_rng(5).normal(size=(4, 4)))[0]
+        qa = _fresh(env)
+        qb = _fresh(env)
+        plan = qt.FaultPlan("oom@0")
+        before = T.counter_total("oom_retries_total")
+        R._ACTIVE_FAULTS[0] = plan
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with qt.gateFusion(qa):
+                    qt.multiQubitUnitary(qa, [0, 1], u)
+        finally:
+            R._ACTIVE_FAULTS[0] = None
+        with qt.gateFusion(qb):
+            qt.multiQubitUnitary(qb, [0, 1], u)
+        assert plan.log == ["oom@0"]
+        assert T.counter_total("oom_retries_total") == before + 1
+        np.testing.assert_array_equal(np.asarray(qa.amps),
+                                      np.asarray(qb.amps))
+
 
 @pytest.fixture
 def _no_fault_hook():
