@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-regress bench docs clean
 
 all: native
 
@@ -23,9 +23,17 @@ $(NATIVE_SO): $(NATIVE_DIR)/scheduler.cc
 test: native
 	python -m pytest tests/ -q
 
+# Static analysis gate (docs/design.md §23): qlint over the full tree
+# (zero unsuppressed findings, every suppression justified) plus the
+# @sharded_contract declarations verified against compiled HLO on an
+# 8-shard CPU dryrun.  Budget: < 10 s.  XLA_FLAGS must be set before
+# the jax backend initializes, hence here and not inside the module.
+verify-static:
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m quest_tpu.analysis --contracts
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify:
+verify: verify-static
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

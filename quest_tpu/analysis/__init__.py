@@ -1,0 +1,30 @@
+"""qlint: static analysis for trace-safety, layering, and
+sharded-collective contracts (docs/design.md §23).
+
+Entry points:
+
+* ``python -m quest_tpu.analysis`` — walk quest_tpu/, tests/, scripts/
+  and report unsuppressed findings (exit 1 on findings, 2 on usage or
+  baseline errors).
+* ``python -m quest_tpu.analysis --contracts`` — additionally verify
+  every @sharded_contract declaration against compiled HLO on the
+  8-shard CPU dryrun.
+* :func:`analyze_paths` / :func:`analyze_source` — library API used by
+  tests/test_analysis.py.
+"""
+
+from .engine import (  # noqa: F401
+    BASELINE_DEFAULT,
+    DEFAULT_WALK,
+    Finding,
+    REPO_ROOT,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    iter_python_files,
+    load_baseline,
+    register,
+    write_baseline,
+)

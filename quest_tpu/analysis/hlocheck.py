@@ -1,0 +1,218 @@
+"""Verify @sharded_contract declarations against compiled HLO.
+
+Each wrapper in :data:`quest_tpu.contracts.REQUIRED_WRAPPERS` declares
+the exact collective-opcode histogram and a per-shard exchange-byte cap
+for its CANONICAL verification dispatch — a fixed 8-shard CPU-dryrun
+configuration chosen here (n=10 state bits, r=3 mesh bits, float32,
+monolithic chunking) so the compiled shape is deterministic across
+backends and the x64 test flag.  The check compiles each dispatch with
+``introspect.audit`` (the same machinery the HLO pin tests use) and
+fails when:
+
+* the histogram of collective FAMILIES (``-start`` async variants folded
+  into their base opcode) differs from the declaration;
+* the bytes moved by the largest collective's operands exceed
+  ``max_exchange_bytes`` (parsed from the HLO output shapes);
+* a required wrapper is missing a contract, or a contract names a
+  wrapper that no longer exists.
+
+Promoted from scripts/tpu_sharded_contract.py (the on-chip evidence
+script); ``make verify-static`` runs this on the virtual 8-device CPU
+mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Dict, List, Tuple
+
+# f32[2,64]{1,0} etc. — HLO array shape with element type
+_SHAPE_RE = re.compile(r"\b([a-z]+)(8|16|32|64|128)\[([0-9,]*)\]")
+_ELEM_BYTES = {"8": 1, "16": 2, "32": 4, "64": 8, "128": 16}
+
+CANONICAL_N = 10          # state bits of the canonical dispatch
+CANONICAL_SHARDS = 8      # r = 3 mesh bits
+
+
+def _shape_bytes(segment: str) -> int:
+    """Largest single-array byte size among the shapes in an HLO text
+    segment (the collective's output tuple for -start variants includes
+    context scalars; max picks the payload)."""
+    best = 0
+    for m in _SHAPE_RE.finditer(segment):
+        elems = 1
+        dims = m.group(3)
+        if dims:
+            for d in dims.split(","):
+                elems *= int(d)
+        best = max(best, elems * _ELEM_BYTES[m.group(2)])
+    return best
+
+
+def _family_histogram(collectives: Dict[str, int]) -> Dict[str, int]:
+    """Fold -start/-done async opcode variants into their base family
+    (a started collective is still one collective)."""
+    out: Dict[str, int] = {}
+    for op, n in collectives.items():
+        fam = op
+        for suffix in ("-start", "-done"):
+            if fam.endswith(suffix):
+                fam = fam[:-len(suffix)]
+        if op.endswith("-done"):
+            continue  # the matching -start already counted it
+        out[fam] = out.get(fam, 0) + n
+    return out
+
+
+def _measured_exchange_bytes(hlo_text: str, families) -> int:
+    """Max payload bytes over the contract's collective instructions."""
+    best = 0
+    for line in hlo_text.splitlines():
+        if any(f" {fam}(" in line or f" {fam}-start(" in line
+               for fam in families):
+            best = max(best, _shape_bytes(line))
+    return best
+
+
+def ensure_mesh():
+    """The 8-device virtual CPU mesh the canonical dispatches compile
+    against.  Raises RuntimeError (with the fix) when the backend came
+    up with fewer devices — XLA_FLAGS must be set before jax's backend
+    initializes, so the CLI cannot set it retroactively."""
+    import quest_tpu as qt
+    env = qt.createQuESTEnv()
+    if env.num_ranks < CANONICAL_SHARDS:
+        raise RuntimeError(
+            f"contract verification needs the {CANONICAL_SHARDS}-device "
+            f"virtual mesh, got {env.num_ranks} — run with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count="
+            f"{CANONICAL_SHARDS} (make verify-static does)")
+    return env
+
+
+def canonical_cases(env) -> Dict[str, Tuple[Callable, object, bool]]:
+    """wrapper name -> (dispatch thunk, sharded input, donate flag).
+
+    The configs mirror the HLO pin tests (tests/test_distributed_hlo.py)
+    scaled to n=10 so the whole sweep compiles in a couple of seconds:
+    every wrapper exercises its collective path (sharded target / mesh
+    bit / bra mesh bit / mixed local-mesh sigma) with chunks pinned to
+    monolithic so the histogram is chunk-independent.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.parallel import dist as PAR
+
+    n = CANONICAL_N
+
+    def state(seed):
+        rng = np.random.default_rng(seed)
+        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
+        a /= np.sqrt((a ** 2).sum())
+        return jax.device_put(jnp.asarray(a), env.amp_sharding())
+
+    h = (1 / np.sqrt(2)) * np.asarray([[1, 1], [1, -1]], np.float32)
+    m = jnp.asarray(np.stack([h, np.zeros((2, 2), np.float32)]))
+    # bit 0 <-> bit n-1: one mixed local/mesh transposition
+    sigma = PAR.canonical_sigma(
+        (n - 1,) + tuple(range(1, n - 1)) + (0,))
+
+    return {
+        "apply_matrix_1q_sharded": (
+            lambda a: PAR.apply_matrix_1q_sharded(
+                a, m, mesh=env.mesh, num_qubits=n, target=n - 1,
+                chunks=1),
+            state(1), True),
+        "swap_sharded": (
+            lambda a: PAR.swap_sharded(
+                a, mesh=env.mesh, num_qubits=n, qb_low=0, qb_high=n - 1,
+                chunks=1),
+            state(2), True),
+        "gather_replicated": (
+            lambda a: PAR.gather_replicated(a, mesh=env.mesh),
+            state(3), False),
+        "mix_pair_channel_sharded": (
+            lambda a: PAR.mix_pair_channel_sharded(
+                a, 0.3, mesh=env.mesh, num_qubits=n // 2,
+                target=n // 2 - 1, kind="depol", chunks=1),
+            state(4), True),
+        "remap_sharded": (
+            lambda a: PAR.remap_sharded(
+                a, mesh=env.mesh, num_qubits=n, sigma=sigma,
+                chunks=(1, 1)),
+            state(5), True),
+    }
+
+
+def verify_sharded_contracts(env=None, contracts=None) -> List[str]:
+    """Compile every canonical dispatch and diff against declarations.
+    Returns a list of human-readable failures (empty = all verified).
+    ``contracts`` overrides the registry (the drift test passes a
+    perturbed copy)."""
+    from quest_tpu import introspect
+    from quest_tpu.contracts import REQUIRED_WRAPPERS, SHARDED_CONTRACTS
+    # decorating module must be imported for the registry to populate
+    from quest_tpu.parallel import dist as _dist  # noqa: F401
+
+    if env is None:
+        env = ensure_mesh()
+    if contracts is None:
+        contracts = dict(SHARDED_CONTRACTS)
+
+    errors: List[str] = []
+    for name in REQUIRED_WRAPPERS:
+        if name not in contracts:
+            errors.append(
+                f"{name}: required wrapper carries no @sharded_contract "
+                f"declaration")
+    for name in contracts:
+        if name not in REQUIRED_WRAPPERS:
+            errors.append(
+                f"{name}: contract declared for a wrapper not in "
+                f"contracts.REQUIRED_WRAPPERS — add it there or drop "
+                f"the decorator")
+    if errors:
+        return errors
+
+    cases = canonical_cases(env)
+    for name in REQUIRED_WRAPPERS:
+        decl = contracts[name]
+        fn, amps, donate = cases[name]
+        report = introspect.audit(fn, amps, donate=donate)
+        measured = _family_histogram(report.collectives)
+        if measured != dict(decl.collectives):
+            errors.append(
+                f"{name}: compiled HLO holds {measured or '{}'} but the "
+                f"@sharded_contract declares {dict(decl.collectives)} "
+                f"(canonical {CANONICAL_SHARDS}-shard dispatch, "
+                f"n={CANONICAL_N})")
+            continue
+        got_bytes = _measured_exchange_bytes(report.text,
+                                             decl.collectives.keys())
+        if got_bytes > decl.max_exchange_bytes:
+            errors.append(
+                f"{name}: largest collective payload is {got_bytes} B, "
+                f"over the declared max_exchange_bytes="
+                f"{decl.max_exchange_bytes}")
+    return errors
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        errors = verify_sharded_contracts()
+    except RuntimeError as e:
+        print(f"qlint contracts: ERROR {e}")
+        return 2
+    if errors:
+        for e in errors:
+            print(f"qlint contracts: FAIL {e}")
+        return 1
+    from quest_tpu.contracts import SHARDED_CONTRACTS
+    for name, c in sorted(SHARDED_CONTRACTS.items()):
+        print(f"qlint contracts: ok {name} {dict(c.collectives)} "
+              f"<= {c.max_exchange_bytes} B")
+    return 0

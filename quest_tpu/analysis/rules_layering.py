@@ -1,0 +1,259 @@
+"""qlint rule family 2: layering & sharded-collective contracts.
+
+**Layer DAG.**  The paper's structural rule ("API functions should never
+call each other"; all inter-device communication lives in one exchange
+layer) maps onto this package as an IMPORT-ORDER DAG over top-level
+imports:
+
+    api (api, api_ops, debug, models)          rank 0
+      ↓
+    orchestration (fusion, batch, circuit,     rank 1
+      resilience, checkpoint, introspect,
+      governor)
+      ↓
+    dist (parallel/*)                          rank 2
+      ↓
+    ops (ops/*)                                rank 3
+      ↓
+    env (env)                                  rank 4
+
+plus a **shared** stratum (validation, precision, rng, telemetry,
+contracts, qureg, qasm, utils, native, analysis) importable from every
+layer but itself restricted to shared + env.  Note the DAG ranks what
+may IMPORT what at module level, which is not the same as runtime call
+flow: dist ranks above ops because dist.py composes ops kernels into
+shard bodies (imports them), never the reverse.  Function-scope lazy
+imports are the package's documented cycle-breaking idiom (see the
+EXCHANGE_FAULT_HOOK note in parallel/dist.py) and are deliberately NOT
+flagged — the rule reads only module-level ``import``/``from`` nodes.
+
+**Collective confinement.**  ``lax.ppermute``/``psum``/``all_gather``/
+``all_to_all`` callsites are flagged anywhere outside
+quest_tpu/parallel/dist.py — the single exchange layer whose wrappers
+carry budget guards, fault hooks, and telemetry.  A collective issued
+elsewhere bypasses all three.
+
+**Contract presence.**  Every wrapper named in
+``quest_tpu.contracts.REQUIRED_WRAPPERS`` must carry the
+``@sharded_contract`` decorator; the declaration itself is verified
+against compiled HLO by hlocheck.py (``--contracts``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from .engine import Finding, Rule, _all_nodes, register
+from ..contracts import REQUIRED_WRAPPERS
+
+PACKAGE = "quest_tpu"
+
+# module key (first path component under quest_tpu/, or module stem) ->
+# layer name.  Keep in sync with the diagram in docs/design.md §23.
+LAYER_OF = {
+    "api": "api", "api_ops": "api", "debug": "api", "models": "api",
+    "fusion": "orch", "batch": "orch", "circuit": "orch",
+    "resilience": "orch", "checkpoint": "orch", "introspect": "orch",
+    "governor": "orch",
+    "parallel": "dist",
+    "ops": "ops",
+    "env": "env",
+}
+
+LAYER_RANK = {"api": 0, "orch": 1, "dist": 2, "ops": 3, "env": 4}
+
+# importable from everywhere; may import only shared + env
+SHARED = {"validation", "precision", "rng", "telemetry", "contracts",
+          "qureg", "qasm", "utils", "native", "analysis"}
+
+COLLECTIVE_NAMES = {"ppermute", "psum", "psum_scatter", "all_gather",
+                    "all_to_all", "pshuffle", "pmean", "pmax", "pmin",
+                    "axis_index_groups"}
+EXCHANGE_LAYER = "quest_tpu/parallel/dist.py"
+
+
+def _module_key(path: str) -> Optional[str]:
+    """quest_tpu/ops/kernels.py -> 'ops'; quest_tpu/env.py -> 'env';
+    None for the package root __init__ and non-package files."""
+    parts = path.split("/")
+    if parts[0] != PACKAGE or len(parts) < 2:
+        return None
+    if len(parts) == 2:
+        stem = parts[1][:-3] if parts[1].endswith(".py") else parts[1]
+        return None if stem == "__init__" else stem
+    return parts[1]
+
+
+def _imported_keys(node, path: str) -> Iterator[Tuple[str, ast.AST]]:
+    """Module keys (under quest_tpu) a top-level import node pulls in."""
+    pkg_parts = path.split("/")[:-1]  # containing package of this file
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            parts = alias.name.split(".")
+            if parts[0] == PACKAGE and len(parts) > 1:
+                yield parts[1], node
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            parts = (node.module or "").split(".")
+            if parts[0] != PACKAGE:
+                return
+            if len(parts) > 1:
+                yield parts[1], node
+            else:
+                # `from quest_tpu import fusion, env` — names are modules
+                for alias in node.names:
+                    yield alias.name, node
+            return
+        # relative: resolve against the containing package
+        base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+        if not base or base[0] != PACKAGE:
+            return
+        if node.module:
+            target = base + node.module.split(".")
+            if len(target) > 1:
+                yield target[1], node
+        else:
+            # `from . import x, y` — each name is a module
+            for alias in node.names:
+                target = base + [alias.name]
+                if len(target) > 1:
+                    yield target[1], node
+
+
+def _top_level_imports(tree: ast.Module) -> Iterator[ast.AST]:
+    """Module-level import nodes, including those inside top-level
+    try/except and `if TYPE_CHECKING:` shims — but NOT function bodies
+    (lazy imports are the sanctioned cycle-breaking idiom)."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, (ast.Try, ast.If)):
+            stack.extend(getattr(node, "body", ()))
+            stack.extend(getattr(node, "orelse", ()))
+            stack.extend(getattr(node, "finalbody", ()))
+            for h in getattr(node, "handlers", ()):
+                stack.extend(h.body)
+
+
+@register
+class LayerViolationRule(Rule):
+    id = "layer-violation"
+    doc = ("module-level import against the layer DAG "
+           "(api → orch → dist → ops → env, shared importable by all) — "
+           "upward or lateral imports couple layers the design keeps "
+           "independent")
+    scope = ("quest_tpu/",)
+    exclude = ("quest_tpu/__init__.py",)
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        me = _module_key(path)
+        if me is None:
+            return
+        my_layer = LAYER_OF.get(me)
+        for node in _top_level_imports(tree):
+            for key, at in _imported_keys(node, path):
+                if key == me:
+                    continue  # intra-layer submodule import
+                dep_layer = LAYER_OF.get(key)
+                if me in SHARED:
+                    if key in SHARED or dep_layer == "env":
+                        continue
+                    yield self.finding(
+                        path, at,
+                        f"shared module '{me}' imports layered module "
+                        f"'{key}' at module level — shared modules may "
+                        f"import only shared/env")
+                    continue
+                if key in SHARED or my_layer is None:
+                    continue
+                if dep_layer is None:
+                    continue
+                if my_layer == "api" and dep_layer == "api":
+                    yield self.finding(
+                        path, at,
+                        f"api-layer module '{me}' imports api-layer "
+                        f"module '{key}' — API functions must not call "
+                        f"each other (compose via the orchestration "
+                        f"layer)")
+                elif LAYER_RANK[dep_layer] < LAYER_RANK[my_layer]:
+                    yield self.finding(
+                        path, at,
+                        f"'{me}' ({my_layer}, rank "
+                        f"{LAYER_RANK[my_layer]}) imports '{key}' "
+                        f"({dep_layer}, rank {LAYER_RANK[dep_layer]}) — "
+                        f"upward import against the layer DAG")
+
+
+@register
+class CollectiveOutsideDistRule(Rule):
+    id = "collective-outside-dist"
+    doc = ("lax collective callsite outside parallel/dist.py — all "
+           "inter-shard communication must go through the exchange "
+           "layer's guarded wrappers")
+    exclude = (EXCHANGE_LAYER,)
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        # names imported directly from jax.lax count as collective calls
+        from_lax = set()
+        for node in _all_nodes(tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    (node.module or "").endswith("lax"):
+                for alias in node.names:
+                    if alias.name in COLLECTIVE_NAMES:
+                        from_lax.add(alias.asname or alias.name)
+        for node in _all_nodes(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = None
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in COLLECTIVE_NAMES:
+                name = f.attr
+            elif isinstance(f, ast.Name) and f.id in from_lax:
+                name = f.id
+            if name is not None:
+                yield self.finding(
+                    path, node,
+                    f"'{name}' issued outside the exchange layer "
+                    f"({EXCHANGE_LAYER}) — use the guarded sharded "
+                    f"wrappers")
+
+
+@register
+class ContractMissingRule(Rule):
+    id = "contract-missing"
+    doc = ("registered sharded dispatch wrapper without a "
+           "@sharded_contract declaration — its collective shape would "
+           "be unpinned against HLO drift")
+    scope = (EXCHANGE_LAYER,)
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        seen = {}
+        for node in _all_nodes(tree):
+            if isinstance(node, ast.FunctionDef):
+                seen[node.name] = node
+        for name in REQUIRED_WRAPPERS:
+            fn = seen.get(name)
+            if fn is None:
+                continue  # wrapper moved/renamed; registry drift shows
+                # up in hlocheck, not here
+            if not any(self._is_contract(dec)
+                       for dec in fn.decorator_list):
+                yield self.finding(
+                    path, fn,
+                    f"sharded dispatch wrapper '{name}' carries no "
+                    f"@sharded_contract declaration "
+                    f"(quest_tpu/contracts.py)")
+
+    @staticmethod
+    def _is_contract(dec) -> bool:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        while isinstance(target, ast.Attribute):
+            if target.attr == "sharded_contract":
+                return True
+            target = target.value
+        return isinstance(target, ast.Name) and \
+            target.id == "sharded_contract"

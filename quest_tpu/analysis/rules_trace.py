@@ -1,0 +1,621 @@
+"""qlint rule family 1: trace-safety & hygiene.
+
+The hazards these rules catch are the ones the repo's hard invariants
+hang on (docs/design.md §23):
+
+* a host sync (``.item()``, ``float()``, ``np.asarray``,
+  ``block_until_ready``) inside a traced entry point turns a fused
+  device program into a per-call round-trip — or fails outright under
+  ``jit``;
+* a Python ``if``/``while`` on a tracer-valued expression raises a
+  ConcretizationTypeError only on the code path that reaches it;
+* telemetry counter mutation inside traced code counts once per TRACE,
+  not per execution (the PR-4 Tracer guard exists exactly for this);
+* ``time.time()`` / unseeded ``random`` anywhere in the product package
+  undermines bit-identical resume (resilience.py's core contract);
+* ``float64`` literals outside precision.py / host table constants
+  silently de-optimize the TPU path (f64 is software-emulated, ~10x);
+* a broad ``except Exception`` without a justified pragma swallows the
+  structured error taxonomy (QuESTError / ShardLossError /
+  MemoryAdmissionError) the recovery layers dispatch on;
+* swallowing ``RESOURCE_EXHAUSTED`` anywhere but governor.oom_net
+  bypasses the governor's evict-and-retry-once protocol.
+
+**Traced scopes** are detected three ways: a ``jax.jit`` decorator
+(including ``partial(jax.jit, static_argnames=...)``), nesting inside a
+traced scope (shard_map kernel bodies), or membership in
+:data:`TRACED_REGISTRY` — the explicit list of functions that execute
+under trace despite carrying no decorator (fusion program parts,
+parallel/dist shard-kernel helpers, ops/* kernels called from jitted
+programs).  Inside a traced scope a light taint pass marks the traced
+parameters (everything not named in ``static_argnames``; for
+registry-traced functions, positional parameters — keyword-only
+arguments are static config by package idiom) and propagates through
+assignments, stopping at static metadata (``.shape``/``.ndim``/
+``.dtype``), ``len``/``isinstance``, and ``is``/``is not`` tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from .engine import Finding, Rule, _all_nodes, register
+
+# ---------------------------------------------------------------------------
+# Traced-scope registry
+# ---------------------------------------------------------------------------
+
+# (path, function name) -> "traced": the body executes under trace.
+# (path, function name) -> "container": the body is host-side planning
+# but every function DEFINED INSIDE it is traced (fusion._plan_runner
+# builds the drain executor: _apply_part/_apply/run/kernel all trace).
+TRACED_REGISTRY: Dict[Tuple[str, str], str] = {
+    # fusion program parts (the drain executor factory)
+    ("quest_tpu/fusion.py", "_plan_runner"): "container",
+    # parallel/dist shard-kernel helpers (called inside shard_map bodies)
+    ("quest_tpu/parallel/dist.py", "exchange_pipelined"): "traced",
+    ("quest_tpu/parallel/dist.py", "_swap_halves_in_shard"): "traced",
+    ("quest_tpu/parallel/dist.py", "_remap_in_shard"): "traced",
+    ("quest_tpu/parallel/dist.py", "_apply_1q_mesh_bit"): "traced",
+    ("quest_tpu/parallel/dist.py", "_shard_coeffs"): "traced",
+    ("quest_tpu/parallel/dist.py", "_parity_phase_sharded"): "traced",
+    ("quest_tpu/parallel/dist.py", "_shard_parity_sign"): "traced",
+    ("quest_tpu/parallel/dist.py", "_mesh_flip_gather"): "traced",
+    ("quest_tpu/parallel/dist.py", "_apply_pauli_sharded"): "traced",
+    ("quest_tpu/parallel/dist.py", "_direct_rotation_sharded"): "traced",
+    ("quest_tpu/parallel/dist.py", "_qft_mesh_layer"): "traced",
+    ("quest_tpu/parallel/dist.py", "_reverse_run_sharded"): "traced",
+    ("quest_tpu/parallel/dist.py", "_apply_local_phase"): "traced",
+}
+
+# whole modules whose top-level functions execute under trace when
+# reached from the fusion drain / sharded kernels (ops/* kernel files).
+# element.py is deliberately absent: it is the host accessor layer
+# (getAmp / reportState stream concrete arrays).
+TRACED_MODULES: Tuple[str, ...] = (
+    "quest_tpu/ops/kernels.py",
+    "quest_tpu/ops/cplx.py",
+    "quest_tpu/ops/density.py",
+    "quest_tpu/ops/paulis.py",
+    "quest_tpu/ops/bigstate.py",
+    "quest_tpu/ops/phasefunc.py",
+)
+
+# The canonical state-array parameter names.  Registry/module-traced
+# functions carry no static_argnames declaration, so the taint seed is
+# name-based: the package idiom passes the traced state as the first
+# positional under one of these names and static config as annotated
+# ints/tuples after it.  Precision over recall — a host helper in a
+# kernel module (kraus-table builders, soa converters) takes differently
+# named params and stays clean.
+ARRAY_PARAM_NAMES = {"amps", "local", "send", "a", "state", "rho",
+                     "shard", "amps_shard"}
+
+# attribute reads that yield STATIC metadata on a tracer (do not
+# propagate taint)
+_STATIC_ATTRS = {"ndim", "shape", "dtype", "size", "itemsize", "nbytes",
+                 "sharding", "weak_type", "aval", "names"}
+
+_STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+                 "range", "enumerate", "zip",
+                 # package routing predicates that read ONLY static
+                 # metadata of their array argument (dtype/shape/ndim)
+                 # and return a host bool at trace time
+                 "_pl_routable", "qft_multilayer_enabled"}
+
+_NP_NAMES = {"np", "numpy", "_np", "onp"}
+
+
+def _jit_decorator_info(fn: ast.AST) -> Optional[Set[str]]:
+    """None if ``fn`` carries no jit decorator; otherwise the set of
+    static argument names the decorator declares."""
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        names = _dotted(target)
+        if names is None:
+            continue
+        if names[-1] == "jit":
+            static: Set[str] = set()
+            if isinstance(dec, ast.Call):
+                # partial(jax.jit, static_argnames=(...)) or
+                # jax.jit(..., static_argnames=...)
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        static |= _str_elements(kw.value)
+                for a in dec.args:
+                    # partial(jax.jit, ...): jit is the first positional
+                    an = _dotted(a)
+                    if an is not None and an[-1] == "jit":
+                        continue
+            return static
+        if names[-1] == "partial" and isinstance(dec, ast.Call):
+            inner = [_dotted(a) for a in dec.args]
+            if any(n is not None and n[-1] == "jit" for n in inner):
+                static = set()
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        static |= _str_elements(kw.value)
+                return static
+    return None
+
+
+def _dotted(node) -> Optional[Tuple[str, ...]]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _str_elements(node) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    out: Set[str] = set()
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+    return out
+
+
+class _Scope:
+    """One function's trace context: traced flag, tainted names, and the
+    function's OWN statement nodes (nested defs excluded — they get
+    their own scope).  ``has_tracer_guard`` is resolved lazily from the
+    own-node list (only the telemetry rule needs it)."""
+
+    def __init__(self, fn, traced: bool, taint: Set[str], own: list):
+        self.fn = fn
+        self.traced = traced
+        self.taint = set(taint)
+        self.own = own
+        self._guard: Optional[bool] = None
+
+    @property
+    def has_tracer_guard(self) -> bool:
+        if self._guard is None:
+            self._guard = any(
+                (isinstance(n, ast.Attribute) and n.attr == "Tracer")
+                or (isinstance(n, ast.Name) and n.id == "Tracer")
+                for n in self.own)
+        return self._guard
+
+
+def _function_scopes(tree: ast.Module, path: str):
+    """(fn_node, _Scope) for every function in the file, with traced-ness
+    resolved from decorators, nesting, and the registry.  Cached on the
+    tree: three rules share one scope computation per file."""
+    cached = getattr(tree, "_qlint_scopes", None)
+    if cached is None:
+        cached = list(_compute_scopes(tree, path))
+        tree._qlint_scopes = cached
+    return cached
+
+
+def _compute_scopes(tree: ast.Module, path: str):
+    module_traced = path in TRACED_MODULES
+
+    def visit(node, enclosing_traced: bool, parent_taint: Set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                static = _jit_decorator_info(child)
+                reg = TRACED_REGISTRY.get((path, child.name))
+                traced = (enclosing_traced or static is not None
+                          or reg == "traced"
+                          or (module_traced and isinstance(
+                              node, ast.Module)))
+                taint: Set[str] = set()
+                args = child.args
+                pos = [a.arg for a in args.posonlyargs + args.args]
+                kwonly = [a.arg for a in args.kwonlyargs]
+                if traced:
+                    if static is not None:
+                        # decorator declares intent exactly: everything
+                        # not named static is a traced operand
+                        taint = {p for p in pos + kwonly
+                                 if p not in static}
+                    else:
+                        # registry/module/nesting-traced: seed by the
+                        # canonical array-param names, plus the
+                        # enclosing scope's taint reaching in through
+                        # the closure (minus shadowing params)
+                        taint = {p for p in pos + kwonly
+                                 if p in ARRAY_PARAM_NAMES}
+                        taint |= parent_taint - set(pos) - set(kwonly)
+                own = list(_own_nodes(child))
+                _propagate_taint(own, taint)
+                yield child, _Scope(child, traced, taint, own)
+                yield from visit(child, traced or reg == "container",
+                                 taint)
+            else:
+                yield from visit(child, enclosing_traced, parent_taint)
+
+    yield from visit(tree, False, set())
+
+
+def _expr_tainted(node, taint: Set[str]) -> bool:
+    """Does evaluating ``node`` touch a traced value?  Static-metadata
+    attribute reads, len/isinstance, and ``is`` tests block taint."""
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, taint)
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func)
+        if fname is not None and fname[-1] in _STATIC_CALLS:
+            return False
+        if fname is not None and fname[0] in _NP_NAMES and \
+                fname[-1] in {"dtype", "finfo", "iinfo", "issubdtype"}:
+            return False
+        return any(_expr_tainted(a, taint) for a in node.args) or \
+            any(_expr_tainted(kw.value, taint) for kw in node.keywords) or \
+            _expr_tainted(node.func, taint)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return _expr_tainted(node.left, taint) or \
+            any(_expr_tainted(c, taint) for c in node.comparators)
+    if isinstance(node, (ast.Constant, ast.Lambda)):
+        return False
+    return any(_expr_tainted(c, taint) for c in ast.iter_child_nodes(node))
+
+
+def _propagate_taint(own_nodes: list, taint: Set[str]) -> None:
+    """One forward pass over simple assignments in the function's own
+    statements (nested defs excluded — they get their own scope)."""
+    if not taint:
+        return
+    for node in own_nodes:
+        if isinstance(node, ast.Assign) and \
+                _expr_tainted(node.value, taint):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        taint.add(n.id)
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name) and \
+                _expr_tainted(node.value, taint):
+            taint.add(node.target.id)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync-in-traced"
+    doc = ("host synchronization (.item()/.tolist()/float()/np.asarray/"
+           "block_until_ready/device_get) on a traced value inside a "
+           "registered traced entry point")
+
+    _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+    _SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for fn, scope in _function_scopes(tree, path):
+            if not scope.traced or not scope.taint:
+                continue
+            for node in scope.own:
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in self._SYNC_METHODS and \
+                        _expr_tainted(f.value, scope.taint):
+                    yield self.finding(
+                        path, node,
+                        f"'.{f.attr}()' on a traced value in traced "
+                        f"function '{fn.name}' forces a host sync")
+                elif isinstance(f, ast.Name) and \
+                        f.id in self._SYNC_CASTS and node.args and \
+                        _expr_tainted(node.args[0], scope.taint):
+                    yield self.finding(
+                        path, node,
+                        f"'{f.id}()' cast of a traced value in traced "
+                        f"function '{fn.name}' forces a host sync")
+                else:
+                    fname = _dotted(f)
+                    if fname is None:
+                        continue
+                    if (fname[0] in _NP_NAMES
+                            and fname[-1] in {"asarray", "array"}
+                            and node.args
+                            and _expr_tainted(node.args[0], scope.taint)):
+                        yield self.finding(
+                            path, node,
+                            f"'{'.'.join(fname)}' on a traced value in "
+                            f"traced function '{fn.name}' materializes "
+                            f"the array on host")
+                    elif fname[-1] == "device_get" and node.args and \
+                            _expr_tainted(node.args[0], scope.taint):
+                        yield self.finding(
+                            path, node,
+                            f"jax.device_get on a traced value in traced "
+                            f"function '{fn.name}'")
+
+
+@register
+class TracerBranchRule(Rule):
+    id = "tracer-branch"
+    doc = ("Python if/while on a tracer-valued expression inside a "
+           "traced entry point (ConcretizationTypeError at trace time; "
+           "use lax.cond / jnp.where)")
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for fn, scope in _function_scopes(tree, path):
+            if not scope.traced or not scope.taint:
+                continue
+            for node in scope.own:
+                if isinstance(node, (ast.If, ast.While)) and \
+                        _expr_tainted(node.test, scope.taint):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        path, node,
+                        f"Python '{kind}' on a traced expression in "
+                        f"traced function '{fn.name}' — use lax.cond / "
+                        f"lax.select / jnp.where")
+                elif isinstance(node, ast.IfExp) and \
+                        _expr_tainted(node.test, scope.taint):
+                    yield self.finding(
+                        path, node,
+                        f"conditional expression on a traced test in "
+                        f"traced function '{fn.name}' — use jnp.where")
+
+
+@register
+class TelemetryInTracedRule(Rule):
+    id = "telemetry-in-traced"
+    doc = ("telemetry counter mutation inside traced code without the "
+           "Tracer guard — counts once per trace, not per execution")
+
+    _MUTATORS = {"inc", "observe", "set_gauge", "record_exchange",
+                 "inc_key"}
+    _MODULES = {"telemetry", "_telemetry"}
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for fn, scope in _function_scopes(tree, path):
+            if not scope.traced:
+                continue
+            for node in scope.own:
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = _dotted(node.func)
+                if fname is None or len(fname) < 2:
+                    continue
+                if fname[0] in self._MODULES and \
+                        fname[-1] in self._MUTATORS:
+                    if scope.has_tracer_guard:
+                        continue
+                    yield self.finding(
+                        path, node,
+                        f"telemetry.{fname[-1]} inside traced function "
+                        f"'{fn.name}' without an isinstance(x, "
+                        f"jax.core.Tracer) guard")
+
+
+@register
+class NondeterminismRule(Rule):
+    id = "nondeterminism"
+    doc = ("wall-clock / unseeded-RNG source in the product package — "
+           "breaks bit-identical resume unless recorded and justified")
+    scope = ("quest_tpu/",)
+
+    _LEGACY_NP_SAMPLERS = {"rand", "randn", "random", "random_sample",
+                           "randint", "choice", "permutation", "shuffle",
+                           "normal", "uniform", "bytes"}
+    _STDLIB_SAMPLERS = {"random", "randint", "randrange", "choice",
+                        "shuffle", "uniform", "sample", "getrandbits",
+                        "gauss"}
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        has_random_import = any(
+            isinstance(n, ast.Import) and
+            any(a.name == "random" for a in n.names)
+            for n in _all_nodes(tree))
+        for node in _all_nodes(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname is None:
+                continue
+            if fname[-1] in {"time", "time_ns"} and len(fname) >= 2 and \
+                    fname[-2] in {"time", "_time"}:
+                yield self.finding(
+                    path, node,
+                    "wall-clock time.time() feeds program state — breaks "
+                    "bit-identical replay unless the value is recorded")
+            elif len(fname) == 2 and fname[0] == "random" and \
+                    fname[1] in self._STDLIB_SAMPLERS and \
+                    has_random_import:
+                yield self.finding(
+                    path, node,
+                    f"stdlib random.{fname[1]} draws from the unseeded "
+                    f"process-global stream")
+            elif len(fname) >= 3 and fname[0] in _NP_NAMES and \
+                    fname[1] == "random" and \
+                    fname[2] in self._LEGACY_NP_SAMPLERS:
+                yield self.finding(
+                    path, node,
+                    f"np.random.{fname[2]} draws from the unseeded "
+                    f"legacy global RNG — use a seeded Generator / "
+                    f"rng.GLOBAL_RNG")
+            elif len(fname) >= 3 and fname[0] in _NP_NAMES and \
+                    fname[1] == "random" and fname[2] == "default_rng" \
+                    and not node.args:
+                yield self.finding(
+                    path, node,
+                    "np.random.default_rng() without a seed is "
+                    "entropy-seeded — pass an explicit seed")
+
+
+@register
+class F64LiteralRule(Rule):
+    id = "f64-literal"
+    doc = ("float64/complex128 dtype literal outside precision.py and "
+           "host table constants — f64 is software-emulated on TPU "
+           "(~10x); route precision through precision.py")
+    scope = ("quest_tpu/",)
+    exclude = ("quest_tpu/precision.py",)
+
+    _F64 = {"float64", "complex128"}
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        exempt: set = set()
+        dtype_strings: list = []
+        for node in _all_nodes(tree):
+            # comparisons against a dtype are reads, not selections
+            if isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    exempt.add(id(sub))
+            elif isinstance(node, ast.IfExp) and \
+                    isinstance(node.test, ast.Compare):
+                # the dtype-mirroring idiom:
+                # dt = np.float64 if x.dtype == jnp.float64 else np.float32
+                # selects to MATCH an input's precision, never to raise it
+                for branch in (node.body, node.orelse):
+                    if isinstance(branch, ast.Attribute) and \
+                            branch.attr in self._F64:
+                        exempt.add(id(branch))
+            elif isinstance(node, ast.Call):
+                fname = _dotted(node.func)
+                if fname is None:
+                    # e.g. np.diag(...).astype(np.complex128): host
+                    # numpy table constant built then cast
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "astype" and \
+                            self._np_rooted(node.func.value):
+                        for a in node.args:
+                            for sub in ast.walk(a):
+                                exempt.add(id(sub))
+                    continue
+                # np.dtype()/np.finfo()/np.issubdtype(): introspection
+                if fname[-1] in {"dtype", "finfo", "iinfo", "issubdtype"}:
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        for sub in ast.walk(a):
+                            exempt.add(id(sub))
+                # host numpy table constants: np.arange/zeros/asarray(...,
+                # dtype=np.float64) build static pass arrays — the
+                # deliberate "table-constant allowlist"
+                elif fname[0] in _NP_NAMES:
+                    for kw in node.keywords:
+                        if kw.arg == "dtype":
+                            for sub in ast.walk(kw.value):
+                                exempt.add(id(sub))
+                    for a in node.args:
+                        for sub in ast.walk(a):
+                            if isinstance(sub, ast.Attribute) and \
+                                    sub.attr in self._F64:
+                                exempt.add(id(sub))
+                # dtype STRINGS only count in dtype contexts: a
+                # dtype= kwarg or an .astype()/asarray() argument —
+                # a bare "float64" string elsewhere is just text
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value in self._F64:
+                        dtype_strings.append(kw.value)
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in {"astype", "view"}:
+                    for a in node.args:
+                        if isinstance(a, ast.Constant) and \
+                                a.value in self._F64:
+                            dtype_strings.append(a)
+        for node in _all_nodes(tree):
+            if id(node) in exempt:
+                continue
+            if isinstance(node, ast.Attribute) and node.attr in self._F64:
+                root = _dotted(node)
+                yield self.finding(
+                    path, node,
+                    f"{'.'.join(root) if root else node.attr} dtype "
+                    f"literal outside the precision.py/table-constant "
+                    f"allowlist")
+        for node in dtype_strings:
+            if id(node) in exempt:
+                continue
+            yield self.finding(
+                path, node,
+                f"'{node.value}' dtype string outside the "
+                f"precision.py/table-constant allowlist")
+
+    @classmethod
+    def _np_rooted(cls, node) -> bool:
+        """Is the expression a call/attribute chain rooted at numpy?"""
+        while isinstance(node, (ast.Attribute, ast.Call, ast.Subscript)):
+            node = (node.func if isinstance(node, ast.Call)
+                    else node.value)
+        return isinstance(node, ast.Name) and node.id in _NP_NAMES
+
+
+@register
+class BroadExceptRule(Rule):
+    id = "broad-except"
+    doc = ("bare/broad except without a justified pragma — swallows the "
+           "structured error taxonomy (QuESTError, ShardLossError, "
+           "MemoryAdmissionError) the recovery layers dispatch on")
+    scope = ("quest_tpu/",)
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for node in _all_nodes(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            broad = t is None or (
+                isinstance(t, ast.Name) and
+                t.id in {"Exception", "BaseException"})
+            if not broad:
+                continue
+            # cleanup-and-reraise never swallows: the taxonomy still
+            # propagates (fusion's drain-requeue is the canonical case)
+            if any(isinstance(s, ast.Raise) and s.exc is None
+                   for s in node.body):
+                continue
+            what = "bare except" if t is None else f"except {t.id}"
+            yield self.finding(
+                path, node,
+                f"{what} without narrowing — name the expected "
+                f"failure classes or justify with a qlint pragma")
+
+
+@register
+class OomSwallowRule(Rule):
+    id = "oom-swallow"
+    doc = ("RESOURCE_EXHAUSTED handled outside governor.oom_net — only "
+           "the governor may catch allocation failure (evict-and-retry-"
+           "once protocol, docs/design.md §22)")
+    scope = ("quest_tpu/",)
+    exclude = ("quest_tpu/governor.py",)
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for node in _all_nodes(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            seg = ast.get_source_segment(src, node) or ""
+            if "RESOURCE_EXHAUSTED" in seg or "_is_oom" in seg:
+                yield self.finding(
+                    path, node,
+                    "except handler inspects RESOURCE_EXHAUSTED outside "
+                    "governor.oom_net — route OOM recovery through the "
+                    "memory governor")
+
+
+def _own_nodes(fn) -> Iterator[ast.AST]:
+    """Walk a function's body EXCLUDING nested function definitions
+    (those get their own scope entry)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)

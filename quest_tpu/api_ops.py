@@ -23,6 +23,7 @@ from .ops import phasefunc as PF
 from .precision import get_precision, real_eps
 from .qureg import DiagonalOp, PauliHamil, Qureg
 from .rng import GLOBAL_RNG
+# qlint: allow(layer-violation): api_ops.py is api.py's size-split continuation (one API surface split across two files, see module docstring), not a second API composing the first; it shares api.py's private helpers by design
 from .api import (
     PAULI_I,
     _apply_diag,

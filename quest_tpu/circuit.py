@@ -123,6 +123,7 @@ def _embed_indices(bits: Tuple[int, ...]):
     rest = idx.copy()
     for b in bits:
         rest &= ~(1 << b)
+    # qlint: allow(f64-literal): host-side plan-table constant — cast to the register dtype at embed time, never shipped to the device as f64
     mask = (rest[:, None] == rest[None, :]).astype(np.float64)
     row = sub[:, None] * np.ones((1, DIM), dtype=np.int64)
     col = np.ones((DIM, 1), dtype=np.int64) * sub[None, :]
@@ -192,6 +193,7 @@ def schmidt_terms_2q(mat_soa) -> Optional[List[tuple]]:
         return None
     try:
         m = np.asarray(mat_soa)
+    # qlint: allow(broad-except): non-materializable values raise framework-version-dependent types; any failure means "not concrete" and the Schmidt path is skipped
     except Exception:  # pragma: no cover - any non-materializable value
         return None
     if m.dtype == object or m.shape != (2, 4, 4):
@@ -240,6 +242,7 @@ def _concrete44(mat_soa):
         return None
     try:
         m = np.asarray(mat_soa)
+    # qlint: allow(broad-except): materialization failure of any type means "traced/odd value" — the concrete-matrix fast path just declines
     except Exception:  # pragma: no cover
         return None
     if m.dtype == object or m.shape != (2, 4, 4):
@@ -382,6 +385,7 @@ def is_diag_gate(mat_soa) -> bool:
         return False
     try:
         m = np.asarray(mat_soa)
+    # qlint: allow(broad-except): materialization failure of any type means "not concrete" — a non-diagonal answer is always safe (pass merely stops folding)
     except Exception:  # pragma: no cover
         return False
     if m.dtype == object or m.ndim != 3:
@@ -403,6 +407,7 @@ def _stack_sides(As, Bs):
     eye = _eye_cluster()
     if all(x is None or isinstance(x, np.ndarray) for x in As + Bs):
         dts = [x.dtype for x in As + Bs if x is not None]
+        # qlint: allow(f64-literal): all-identity fallback dtype for a host-side numpy plan table; the register dtype overrides it whenever any real term exists
         dt = dts[0] if dts else np.float64
         a = np.stack([x if x is not None else eye.astype(dt) for x in As])
         b = np.stack([x if x is not None else eye.astype(dt) for x in Bs])

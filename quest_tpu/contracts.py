@@ -1,0 +1,90 @@
+"""Declarative sharded-collective contracts.
+
+The distributed layer's reliability story (qHiPSTER arXiv:1601.07195 §IV,
+mpiQulacs arXiv:2203.16044 §V) rests on the communication layer staying
+auditable: every exchange program has a KNOWN collective shape, and any
+change to it is a deliberate, reviewed event — not a silent regression a
+refactor smuggles in.  Until now that shape lived only in test pins
+(tests/test_distributed_hlo.py); this module moves the declaration onto
+the wrapper itself:
+
+    @sharded_contract(collectives={"collective-permute": 1},
+                      max_exchange_bytes=1 << 10)
+    def swap_sharded(amps, *, mesh, num_qubits, qb_low, qb_high, ...):
+        ...
+
+``collectives`` pins the EXACT HLO collective-opcode histogram of the
+wrapper's canonical verification dispatch (the 8-shard CPU dryrun config
+in quest_tpu/analysis/hlocheck.py — ``-start`` async variants fold into
+their base opcode), and ``max_exchange_bytes`` caps the per-shard ICI
+bytes the wrapper's own cost model records for that dispatch.  The
+declarations are verified against COMPILED HLO by
+``python -m quest_tpu.analysis --contracts`` (make verify-static) via
+introspect.audit / CollectiveBudget, and the qlint ``contract-missing``
+rule statically requires every registered wrapper to carry the decorator
+(docs/design.md §23).
+
+stdlib-only on purpose: parallel/dist.py imports this at module level, so
+it must sit in the shared layer of the import DAG (no jax, no sibling
+modules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedContract:
+    """One wrapper's declared collective shape (see module docstring)."""
+
+    name: str
+    collectives: Dict[str, int]
+    max_exchange_bytes: int
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "collectives": dict(self.collectives),
+                "max_exchange_bytes": int(self.max_exchange_bytes)}
+
+
+# name -> ShardedContract for every decorated wrapper, in decoration
+# order.  hlocheck.verify_sharded_contracts walks this; the static
+# contract-missing rule pins the expected membership below.
+SHARDED_CONTRACTS: Dict[str, ShardedContract] = {}
+
+# The sharded dispatch wrappers REQUIRED to carry a contract — the five
+# guarded_dispatch entry points of parallel/dist.py.  A new wrapper must
+# be added here AND decorated, or qlint's contract-missing rule fails the
+# tree (quest_tpu/analysis/rules_layering.py).
+REQUIRED_WRAPPERS = (
+    "apply_matrix_1q_sharded",
+    "swap_sharded",
+    "gather_replicated",
+    "mix_pair_channel_sharded",
+    "remap_sharded",
+)
+
+
+def sharded_contract(*, collectives: Dict[str, int],
+                     max_exchange_bytes: int,
+                     name: Optional[str] = None) -> Callable:
+    """Declare a sharded dispatch wrapper's collective contract.
+
+    Registers the declaration in :data:`SHARDED_CONTRACTS` and attaches
+    it to the function as ``__sharded_contract__``.  Purely declarative —
+    zero dispatch-time overhead; enforcement happens offline against the
+    compiled HLO (analysis/hlocheck.py)."""
+    decl_collectives = {str(k): int(v) for k, v in collectives.items()}
+
+    def deco(fn: Callable) -> Callable:
+        contract = ShardedContract(
+            name=name or fn.__name__,
+            collectives=decl_collectives,
+            max_exchange_bytes=int(max_exchange_bytes),
+        )
+        SHARDED_CONTRACTS[contract.name] = contract
+        fn.__sharded_contract__ = contract
+        return fn
+
+    return deco

@@ -130,7 +130,7 @@ def _register_cache_listeners() -> None:
 
         _mon.register_event_listener(_on_event)
         _mon.register_event_duration_secs_listener(_on_duration)
-    except Exception:
+    except (ImportError, AttributeError):
         pass
 
 
@@ -174,6 +174,7 @@ def _enable_compilation_cache() -> None:
         # (QT_COMPILE_CACHE / QT_COMPILE_CACHE_DIR force it on anywhere)
         if jax.default_backend() == "cpu" and explicit_dir is None:
             return
+    # qlint: allow(broad-except): cache is best-effort — any config/backend probe failure (version-dependent attribute set) must skip the cache, never break createQuESTEnv
     except Exception:  # pragma: no cover - cache is best-effort
         return
     cache_dir = explicit_dir or os.path.join(
@@ -189,6 +190,7 @@ def _enable_compilation_cache() -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _CACHE_STATS["dir"] = cache_dir
         _register_cache_listeners()
+    # qlint: allow(broad-except): cache is best-effort — mkdir/config failures (read-only FS, old JAX) degrade to uncached compiles rather than failing env creation
     except Exception:  # pragma: no cover - cache is best-effort
         pass
 
@@ -286,6 +288,11 @@ def get_environment_string(env: QuESTEnv) -> str:
     from .parallel import dist
 
     s += f" ExchangeChunks={dist.exchange_config_key() or 'auto'}"
+    # reproducibility surface: when the measurement RNG is still on its
+    # time+pid default seed, report the chosen keys so the run can be
+    # replayed exactly with seedQuEST(env, <keys>) (rng.py contract)
+    if getattr(rng.GLOBAL_RNG, "default_seeded", False):
+        s += " DefaultSeed=" + ",".join(str(k) for k in rng.GLOBAL_RNG._keys)
     cache = compile_cache_stats()
     if cache["dir"]:
         s += (f" CompileCache={cache['dir']}"

@@ -149,6 +149,7 @@ def _device_limit_bytes() -> Optional[int]:
             for d in jax.local_devices():
                 try:
                     stats = d.memory_stats()
+                # qlint: allow(broad-except): memory_stats() support and failure types are backend-dependent; a probe failure just means "no HBM cap known"
                 except Exception:  # pragma: no cover - backend-dependent
                     stats = None
                 cap = (stats or {}).get("bytes_limit")
@@ -156,6 +157,7 @@ def _device_limit_bytes() -> Optional[int]:
                     limit = None
                     break
                 limit = cap if limit is None else min(limit, cap)
+        # qlint: allow(broad-except): device enumeration with no backend raises version-dependent types; the budget simply stays unknown
         except Exception:  # pragma: no cover - no backend at all
             limit = None
         _DEVICE_LIMIT[1] = int(limit) if limit else None
@@ -667,6 +669,7 @@ def oom_net(fn, qureg=None):
 
     try:
         return attempt()
+    # qlint: allow(broad-except): the oom_net — XLA surfaces RESOURCE_EXHAUSTED under backend-specific exception classes, so the net catches everything, re-raises non-OOM unchanged, and retries once after eviction
     except Exception as e:
         if not _is_oom(e):
             raise
@@ -691,6 +694,7 @@ def _recover_from_oom(qureg, err) -> None:
         import jax
 
         jax.clear_caches()
+    # qlint: allow(broad-except): clear_caches is a version-dependent API; OOM recovery must proceed to the retry even when it is absent or fails
     except Exception:  # pragma: no cover - version-dependent API
         pass
     time.sleep(float(os.environ.get("QT_RETRY_BASE_SECONDS", "0.05")))
@@ -741,5 +745,6 @@ def reset() -> None:
     _DEVICE_LIMIT[1] = None
     try:
         _rollback_chunks()
+    # qlint: allow(broad-except): reset() must succeed even before parallel/dist is importable (circular-import window during package init)
     except Exception:  # pragma: no cover - dist not importable yet
         pass

@@ -35,7 +35,7 @@ def _build() -> bool:
         )
         os.replace(tmp, _LIB)  # atomic: concurrent readers never see a torn .so
         return True
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
         try:
             os.unlink(tmp)
         except OSError:

@@ -52,6 +52,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from ..contracts import sharded_contract
 from ..env import AMP_AXIS, shard_map
 from ..ops import cplx, kernels
 
@@ -163,6 +164,7 @@ def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
             t0 = _time.perf_counter()
             try:
                 out = fn(*args, **kwargs)
+            # qlint: allow(broad-except): guarded dispatch retries transient runtime failures of any class (backend RPC errors surface under several types); the final attempt re-raises via ShardLossError with the last error chained
             except Exception as e:  # runtime dispatch failure: retry
                 last = e
             else:
@@ -420,6 +422,8 @@ def _shard_coeffs(rmat_like, mybit):
     return a_re, a_im, b_re, b_im
 
 
+@sharded_contract(collectives={"collective-permute": 1},
+                  max_exchange_bytes=1 << 10)
 def apply_matrix_1q_sharded(
     amps,
     matrix,
@@ -531,6 +535,8 @@ def _apply_matrix_1q_sharded(
     )(amps, jnp.asarray(matrix, amps.dtype))
 
 
+@sharded_contract(collectives={"collective-permute": 1},
+                  max_exchange_bytes=1 << 9)
 def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
                  qb_high: int, chunks: Optional[int] = None):
     """SWAP between a local qubit and a sharded qubit: exchange only the
@@ -587,6 +593,8 @@ def total_prob_sharded(amps, *, mesh: Mesh):
     )(amps)
 
 
+@sharded_contract(collectives={"all-gather": 1},
+                  max_exchange_bytes=1 << 13)
 def gather_replicated(amps, *, mesh: Mesh):
     """Replicate the full state onto every device — the analogue of the
     reference's ring-of-broadcasts copyVecIntoMatrixPairState
@@ -629,6 +637,8 @@ def _pair_channel_weights(kind: str, p, ktv, btv, dt):
     return w1, w2
 
 
+@sharded_contract(collectives={"collective-permute": 1},
+                  max_exchange_bytes=1 << 10)
 def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
                              target: int, kind: str,
                              chunks: Optional[int] = None):
@@ -1603,6 +1613,8 @@ def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
     return local
 
 
+@sharded_contract(collectives={"collective-permute": 1},
+                  max_exchange_bytes=1 << 9)
 def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
                   sigma: Tuple[int, ...],
                   chunks: Optional[Tuple[int, int]] = None):

@@ -617,6 +617,7 @@ def load_latest(ckpt_dir: str, env, *, strict_mesh: bool = False):
             return loaded
         except QuESTError:
             raise  # structured mismatch (precision/qubits): not corruption
+        # qlint: allow(broad-except): corruption shows up as whatever the codec raises (json/struct/OSError/...); any unreadable generation falls back to an older one, with the error surfaced in the warning
         except Exception as e:  # corrupt payload/metadata: try older gen
             last_err = e
             warnings.warn(

@@ -7,11 +7,22 @@ QuEST_cpu_distributed.c:1384-1395).  Here we keep the same generator family
 inherently a device->host sync, matching the reference's semantics — and
 additionally expose key-based ``jax.random`` sampling for fully-jitted
 measurement (quest_tpu.ops.measurement), which the reference cannot do.
+
+Reproducibility contract: the time+pid DEFAULT seed is the one
+nondeterminism source the package cannot avoid (the reference's semantics
+require it).  It is therefore always RECORDED — one ``quest_tpu.rng``
+JSON line on stderr at default-seed time, the chosen keys surfaced as
+``DefaultSeed=`` in ``getEnvironmentString`` (env.py), and
+:attr:`_MeasurementRNG.default_seeded` marking streams that were never
+explicitly seeded — so any run, however started, is replayable with
+``seedQuEST(env, <logged keys>)``.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import sys
 import time
 from typing import Optional, Sequence
 
@@ -25,10 +36,17 @@ class _MeasurementRNG:
     def seed(self, seeds: Sequence[int]) -> None:
         self._keys = [int(s) & 0xFFFFFFFF for s in seeds]
         self._rng = np.random.RandomState(np.random.MT19937(np.array(self._keys, dtype=np.uint32)))
+        self.default_seeded = False
 
     def seed_default(self) -> None:
-        """time + pid default-key seeding (QuEST_common.c:195-217)."""
+        """time + pid default-key seeding (QuEST_common.c:195-217),
+        with the chosen keys logged so the run stays replayable."""
+        # qlint: allow(nondeterminism): QuEST's documented default-seed source (time+pid); the keys are logged below and surfaced as DefaultSeed= in getEnvironmentString so any run replays via seedQuEST
         self.seed([int(time.time()), os.getpid()])
+        self.default_seeded = True
+        print(json.dumps({"event": "quest_tpu.rng.default_seed",
+                          "seeds": self._keys}),
+              file=sys.stderr, flush=True)
 
     def uniform(self) -> float:
         return float(self._rng.random_sample())
@@ -63,6 +81,7 @@ class _MeasurementRNG:
             int(state["has_gauss"]),
             float(state["cached_gaussian"]),
         ))
+        self.default_seeded = False
 
 
 GLOBAL_RNG = _MeasurementRNG()

@@ -321,6 +321,7 @@ def _series():
 
         c[("compile_cache_hits_total", ())] = float(_CACHE_STATS["hits"])
         c[("compile_cache_misses_total", ())] = float(_CACHE_STATS["misses"])
+    # qlint: allow(broad-except): a metrics snapshot must never fail — env can be half-torn-down (interpreter exit) when this import runs
     except Exception:  # pragma: no cover - env not importable mid-teardown
         pass
     try:
@@ -328,6 +329,7 @@ def _series():
 
         for nm in DEGRADATIONS:
             g[("degradation_active", (("name", nm),))] = 1.0
+    # qlint: allow(broad-except): same teardown window as the cache-stats absorb above — the snapshot drops the series rather than raising
     except Exception:  # pragma: no cover
         pass
     return c, g, h

@@ -92,6 +92,7 @@ def memory_watermark() -> dict:
     for d in jax.local_devices():
         try:
             stats = d.memory_stats()
+        # qlint: allow(broad-except): memory_stats() support and failure types are backend-dependent; the sampler records "no stats" and moves on
         except Exception:  # pragma: no cover - backend-dependent API
             stats = None
         stats = dict(stats) if stats else {}
@@ -119,6 +120,7 @@ def memory_watermark() -> dict:
             try:
                 _telemetry.set_gauge("hbm_watermark_bytes", _maxrss_bytes(),
                                      device="host")
+            # qlint: allow(broad-except): max-RSS is a best-effort POSIX probe; a non-POSIX host just skips the watermark sample
             except Exception:  # pragma: no cover - non-POSIX host
                 pass
     return out

@@ -369,6 +369,7 @@ def validate_finite(values, func: str):
     jit) are skipped — they are unknowable at validation time."""
     try:
         arr = np.asarray(values)
+    # qlint: allow(broad-except): tracer materialization raises framework-version-dependent types (ConcretizationTypeError and friends); any failure here means "traced value" and the check is simply skipped
     except Exception:
         return  # traced / non-materializable: nothing to check host-side
     if arr.dtype == object or not np.issubdtype(arr.dtype, np.number):

@@ -1,168 +1,6 @@
-"""Real-chip evidence for the one-kernel-set contract (VERDICT r3 item 1):
-the shard_map composites (Trotter scan, PauliSum expectation scan, the
-general-run fused QFT, and a gateFusion drain program) execute their
-per-shard Pallas kernels on a REAL TPU device under a 1-device mesh and
-match the unsharded paths bit-for-bit-level (f32 tolerance).
-
-This is the same three-way evidence pattern the r3 full-register sharded
-QFT got: virtual-mesh oracle parity (tests/test_distributed.py) + HLO
-collective pinning (tests/test_distributed_hlo.py) + this on-chip run.
-
-Writes scripts/tpu_sharded_contract_result.json.
-"""
-
-import json
-import os
-import sys
-import time
-
+"""Moved: the sharded-collective contract check is product code now —
+``python -m quest_tpu.analysis --contracts`` (quest_tpu/analysis/hlocheck.py)."""
+import os, sys  # noqa: E401
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-RESULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "tpu_sharded_contract_result.json")
-
-
-def log(*a):
-    print(f"[{time.strftime('%H:%M:%S')}]", *a, flush=True)
-
-
-def main():
-    log("importing jax ...")
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh
-
-    log("waiting for device claim ...")
-    t0 = time.time()
-    devs = jax.devices()
-    log(f"claim granted after {time.time() - t0:.0f}s: {devs}")
-
-    from quest_tpu import circuit as CIRC
-    from quest_tpu import fusion
-    from quest_tpu.env import AMP_AXIS
-    from quest_tpu.ops import cplx, fused
-    from quest_tpu.ops import paulis as P
-    from quest_tpu.parallel import dist as PAR
-
-    mesh = Mesh(np.asarray(devs[:1]), (AMP_AXIS,))
-    results = {"devices": str(devs), "mesh": str(mesh)}
-    rng = np.random.default_rng(7)
-
-    def rand_state(n):
-        a = rng.standard_normal((2, 1 << n)).astype(np.float32)
-        a /= np.sqrt((a ** 2).sum())
-        return jnp.asarray(a)
-
-    def maxdiff(x, y):
-        return float(jnp.max(jnp.abs(x - y)))
-
-    # -- 1. Trotter scan: sharded(1-dev mesh) vs unsharded ------------------
-    n = 20
-    T = 8
-    codes = jnp.asarray(rng.integers(0, 4, size=(T, n)), jnp.int32)
-    angles = jnp.asarray(rng.normal(size=T).astype(np.float64))
-    s0 = rand_state(n)
-    log("trotter_scan_sharded compile+run ...")
-    t0 = time.time()
-    a1 = PAR.trotter_scan_sharded(jnp.copy(s0), codes, angles, mesh=mesh,
-                                  num_qubits=n, rep_qubits=n)
-    a1.block_until_ready()
-    results["trotter_sharded_s"] = time.time() - t0
-    a2 = P.trotter_scan(jnp.copy(s0), codes, angles, num_qubits=n,
-                        rep_qubits=n)
-    d = maxdiff(a1, a2)
-    results["trotter_maxdiff"] = d
-    log(f"trotter maxdiff {d:.3e}")
-
-    # -- 2. PauliSum expectation scan --------------------------------------
-    s0 = rand_state(n)
-    coeffs = jnp.asarray(rng.normal(size=T).astype(np.float64))
-    t0 = time.time()
-    e1 = PAR.expec_pauli_sum_scan_sharded(s0, codes, coeffs, mesh=mesh,
-                                          num_qubits=n)
-    e1.block_until_ready()
-    results["expec_sharded_s"] = time.time() - t0
-    e2 = P.expec_pauli_sum_scan(s0, codes, coeffs, num_qubits=n)
-    d = abs(float(e1) - float(e2))
-    results["expec_absdiff"] = d
-    results["expec_value"] = float(e2)
-    log(f"expec diff {d:.3e} (value {float(e2):.6f})")
-
-    # -- 3. density fused QFT (general-run kernel) -------------------------
-    nq = 10
-    nn = 2 * nq
-    s0 = rand_state(nn)
-    runs = ((0, nq, False), (nq, nq, True))
-    log("fused_qft_runs_sharded compile+run ...")
-    t0 = time.time()
-    q1 = PAR.fused_qft_runs_sharded(jnp.copy(s0), mesh=mesh, num_qubits=nn,
-                                    runs=runs)
-    q1.block_until_ready()
-    results["qft_runs_sharded_s"] = time.time() - t0
-    q2 = CIRC.fused_qft(jnp.copy(s0), nn, 0, nq, shifts=(0, nq))
-    q2 = q2.reshape(q1.shape)
-    d = maxdiff(q1, q2)
-    results["density_qft_maxdiff"] = d
-    log(f"density qft maxdiff {d:.3e}")
-
-    # -- 3b. small-shard fully-local run: the dense window passes of
-    # CIRC.fused_qft execute per shard INSIDE the shard_map body at the
-    # smallest window-sized shard (nloc = 15) — the configuration the
-    # adjacent fused_qft_sharded kernel guards against promoting into
-    # scoped VMEM; proves it compiles and matches on real hardware.
-    n15 = 15
-    s0 = rand_state(n15)
-    t0 = time.time()
-    w1 = PAR.fused_qft_runs_sharded(jnp.copy(s0), mesh=mesh, num_qubits=n15,
-                                    runs=((0, n15, False),))
-    w1.block_until_ready()
-    results["small_shard_qft_s"] = time.time() - t0
-    w2 = CIRC.fused_qft(jnp.copy(s0), n15, 0, n15).reshape(w1.shape)
-    d = maxdiff(w1, w2)
-    results["small_shard_qft_maxdiff"] = d
-    log(f"small-shard (nloc=15) qft maxdiff {d:.3e}")
-
-    # -- 4. gateFusion drain program under the 1-device mesh ---------------
-    n = 20
-    s0 = rand_state(n)
-
-    def ru(k):
-        d = 1 << k
-        a = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
-        q, r = np.linalg.qr(a)
-        u = q * (np.diag(r) / np.abs(np.diag(r)))
-        return np.stack([u.real, u.imag]).astype(np.float32)
-
-    gates = []
-    for t in range(n):
-        gates.append(CIRC.Gate((t,), ru(1)))
-    for t in range(0, n - 1, 2):
-        gates.append(CIRC.Gate((t, t + 1), ru(2)))
-    program, arrays = fusion._split_items(gates, n, False)
-    prec = fused.matmul_precision_name()
-    log("drain program (sharded runner) compile+run ...")
-    t0 = time.time()
-    r1 = fusion._plan_runner(n, program, mesh, prec)(jnp.copy(s0),
-                                                     tuple(arrays), ())
-    r1.block_until_ready()
-    results["drain_sharded_s"] = time.time() - t0
-    r2 = fusion._plan_runner(n, program, None, prec)(jnp.copy(s0),
-                                                     tuple(arrays), ())
-    d = maxdiff(r1, r2)
-    results["drain_maxdiff"] = d
-    log(f"drain maxdiff {d:.3e}")
-
-    ok = (results["trotter_maxdiff"] < 1e-5
-          and results["expec_absdiff"] < 1e-4
-          and results["density_qft_maxdiff"] < 1e-5
-          and results["small_shard_qft_maxdiff"] < 1e-5
-          and results["drain_maxdiff"] < 1e-5)
-    results["ok"] = bool(ok)
-    with open(RESULT, "w") as f:
-        json.dump(results, f, indent=2)
-    log("result:", json.dumps(results, indent=2))
-
-
-if __name__ == "__main__":
-    main()
+from quest_tpu.analysis import hlocheck  # noqa: E402
+sys.exit(hlocheck.main())

@@ -1,0 +1,622 @@
+"""qlint static-analysis suite (quest_tpu/analysis, docs/design.md §23).
+
+Three layers of evidence:
+
+* **Fixture corpus** — one minimal snippet per rule: the rule flags its
+  fixture (and ONLY its rule fires on it), and a minimally-corrected
+  twin stays clean, so each rule's positive and negative behaviour is
+  pinned independently.
+* **Engine mechanics** — pragma parsing (reason mandatory, docstrings
+  don't count, unknown rule ids rejected), baseline round-trip (reasons
+  mandatory, stale entries surfaced).
+* **The tree itself** — the full quest_tpu/tests/scripts walk must come
+  back with zero unsuppressed findings, and the @sharded_contract
+  declarations must match compiled HLO, with any perturbed declaration
+  failing the check (drift detection is load-bearing, not decorative).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from quest_tpu import contracts as C
+from quest_tpu.analysis import engine
+
+
+def run(src, path="quest_tpu/fake.py", rules=None):
+    return engine.analyze_source(textwrap.dedent(src), path, rules=rules)
+
+
+def rule_ids(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus: each rule flags its fixture and nothing else
+# ---------------------------------------------------------------------------
+
+
+class TestHostSyncInTraced:
+    def test_item_in_jitted_function_flagged(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def norm(amps):
+                return amps.item()
+            """)
+        assert rule_ids(fs) == ["host-sync-in-traced"]
+        assert ".item()" in fs[0].message
+
+    def test_float_cast_and_asarray_flagged(self):
+        fs = run(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(amps):
+                x = float(amps)
+                y = np.asarray(amps)
+                return x, y
+            """)
+        assert rule_ids(fs) == ["host-sync-in-traced"] * 2
+
+    def test_static_argnames_param_is_not_traced(self):
+        fs = run(
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def f(amps, n):
+                return amps * int(n)
+            """)
+        assert fs == []
+
+    def test_registry_traced_function_flagged(self):
+        # module-traced file: top-level defs with canonical array params
+        fs = run(
+            """
+            def kernel(amps, target):
+                return amps.tolist()
+            """,
+            path="quest_tpu/ops/kernels.py")
+        assert rule_ids(fs) == ["host-sync-in-traced"]
+
+    def test_host_helper_in_kernel_module_stays_clean(self):
+        # differently-named params = host helper (kraus table builders)
+        fs = run(
+            """
+            def build_table(mat):
+                return float(mat[0])
+            """,
+            path="quest_tpu/ops/kernels.py")
+        assert fs == []
+
+    def test_untraced_function_may_sync(self):
+        fs = run(
+            """
+            def get_amp(amps, i):
+                return float(amps[i])
+            """)
+        assert fs == []
+
+
+class TestTracerBranch:
+    def test_if_on_traced_value_flagged(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(amps):
+                if amps[0] > 0:
+                    return amps
+                return -amps
+            """)
+        assert rule_ids(fs) == ["tracer-branch"]
+
+    def test_taint_propagates_through_assignment(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(amps):
+                p = amps * amps
+                while p.sum() > 0:
+                    p = p - 1
+                return p
+            """)
+        assert rule_ids(fs) == ["tracer-branch"]
+
+    def test_branch_on_static_metadata_clean(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(amps, n):
+                if amps.ndim == 2 and len(amps) > 1 and amps is not None:
+                    return amps * n
+                return amps
+            """)
+        assert fs == []
+
+
+class TestTelemetryInTraced:
+    def test_unguarded_mutation_flagged(self):
+        fs = run(
+            """
+            import jax
+            from quest_tpu import telemetry
+
+            @jax.jit
+            def f(amps):
+                telemetry.inc("gates_total")
+                return amps
+            """)
+        assert rule_ids(fs) == ["telemetry-in-traced"]
+
+    def test_tracer_guard_suppresses(self):
+        fs = run(
+            """
+            import jax
+            from quest_tpu import telemetry
+
+            @jax.jit
+            def f(amps):
+                if not isinstance(amps, jax.core.Tracer):
+                    telemetry.inc("gates_total")
+                return amps
+            """)
+        assert fs == []
+
+
+class TestNondeterminism:
+    def test_wall_clock_flagged(self):
+        fs = run(
+            """
+            import time
+
+            def stamp():
+                return time.time()
+            """)
+        assert rule_ids(fs) == ["nondeterminism"]
+
+    def test_unseeded_default_rng_flagged(self):
+        fs = run(
+            """
+            import numpy as np
+
+            def draw():
+                return np.random.default_rng().random()
+            """)
+        assert rule_ids(fs) == ["nondeterminism"]
+
+    def test_seeded_generator_clean(self):
+        fs = run(
+            """
+            import numpy as np
+
+            def draw(seed):
+                return np.random.default_rng(seed).random()
+            """)
+        assert fs == []
+
+    def test_rule_scoped_to_package(self):
+        fs = run(
+            """
+            import time
+
+            def stamp():
+                return time.time()
+            """,
+            path="tests/fake_test.py")
+        assert fs == []
+
+
+class TestF64Literal:
+    def test_jnp_dtype_literal_flagged(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def up(x):
+                return jnp.asarray(x, dtype=jnp.float64)
+            """)
+        assert rule_ids(fs) == ["f64-literal"]
+
+    def test_dtype_string_in_astype_flagged(self):
+        fs = run(
+            """
+            def up(x):
+                return x.astype("complex128")
+            """)
+        assert rule_ids(fs) == ["f64-literal"]
+
+    def test_numpy_table_constant_allowed(self):
+        fs = run(
+            """
+            import numpy as np
+
+            def table(n):
+                return np.arange(n, dtype=np.float64)
+            """)
+        assert fs == []
+
+    def test_dtype_comparison_allowed(self):
+        fs = run(
+            """
+            import numpy as np
+
+            def is_double(x):
+                return x.dtype == np.float64
+            """)
+        assert fs == []
+
+    def test_precision_py_exempt(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+            REAL = jnp.float64
+            """,
+            path="quest_tpu/precision.py")
+        assert fs == []
+
+
+class TestBroadExcept:
+    def test_bare_and_broad_flagged(self):
+        fs = run(
+            """
+            def f(g):
+                try:
+                    return g()
+                except Exception:
+                    return None
+            """)
+        assert rule_ids(fs) == ["broad-except"]
+
+    def test_cleanup_and_reraise_clean(self):
+        fs = run(
+            """
+            def f(g, undo):
+                try:
+                    return g()
+                except BaseException:
+                    undo()
+                    raise
+            """)
+        assert fs == []
+
+    def test_narrow_except_clean(self):
+        fs = run(
+            """
+            def f(g):
+                try:
+                    return g()
+                except (ValueError, OSError):
+                    return None
+            """)
+        assert fs == []
+
+
+class TestOomSwallow:
+    def test_oom_handling_outside_governor_flagged(self):
+        fs = run(
+            """
+            def f(g):
+                try:
+                    return g()
+                except RuntimeError as e:
+                    if "RESOURCE_EXHAUSTED" in str(e):
+                        return None
+                    raise
+            """)
+        assert rule_ids(fs) == ["oom-swallow"]
+
+    def test_governor_exempt(self):
+        fs = run(
+            """
+            def oom_net(g):
+                try:
+                    return g()
+                except RuntimeError as e:
+                    if "RESOURCE_EXHAUSTED" not in str(e):
+                        raise
+                    return None
+            """,
+            path="quest_tpu/governor.py")
+        assert fs == []
+
+
+class TestLayerViolation:
+    def test_upward_import_flagged(self):
+        fs = run(
+            """
+            from quest_tpu import api
+            """,
+            path="quest_tpu/ops/fake.py")
+        assert rule_ids(fs) == ["layer-violation"]
+        assert "upward" in fs[0].message
+
+    def test_api_lateral_import_flagged(self):
+        fs = run(
+            """
+            from quest_tpu import debug
+            """,
+            path="quest_tpu/api.py")
+        assert rule_ids(fs) == ["layer-violation"]
+        assert "API functions must not call each other" in fs[0].message
+
+    def test_shared_module_importing_layered_flagged(self):
+        fs = run(
+            """
+            from quest_tpu import fusion
+            """,
+            path="quest_tpu/qureg.py")
+        assert rule_ids(fs) == ["layer-violation"]
+
+    def test_downward_and_shared_imports_clean(self):
+        fs = run(
+            """
+            from quest_tpu import env
+            from quest_tpu import validation
+            from quest_tpu.ops import kernels
+            """,
+            path="quest_tpu/fusion.py")
+        assert fs == []
+
+    def test_lazy_function_scope_import_not_flagged(self):
+        # the sanctioned cycle-breaking idiom
+        fs = run(
+            """
+            def helper():
+                from quest_tpu import api
+                return api
+            """,
+            path="quest_tpu/ops/fake.py")
+        assert fs == []
+
+
+class TestCollectiveOutsideDist:
+    def test_collective_callsite_flagged(self):
+        fs = run(
+            """
+            from jax import lax
+
+            def exchange(x):
+                return lax.ppermute(x, "amp", [(0, 1)])
+            """,
+            path="quest_tpu/ops/fake.py")
+        assert rule_ids(fs) == ["collective-outside-dist"]
+
+    def test_direct_import_alias_flagged(self):
+        fs = run(
+            """
+            from jax.lax import psum
+
+            def total(x):
+                return psum(x, "amp")
+            """,
+            path="tests/fake_test.py")
+        assert rule_ids(fs) == ["collective-outside-dist"]
+
+    def test_exchange_layer_exempt(self):
+        fs = run(
+            """
+            from jax import lax
+
+            def exchange(x):
+                return lax.ppermute(x, "amp", [(0, 1)])
+            """,
+            path="quest_tpu/parallel/dist.py")
+        assert fs == []
+
+
+class TestContractMissing:
+    def test_undeclared_wrapper_flagged(self):
+        fs = run(
+            """
+            def swap_sharded(amps):
+                return amps
+            """,
+            path="quest_tpu/parallel/dist.py")
+        assert rule_ids(fs) == ["contract-missing"]
+
+    def test_decorated_wrapper_clean(self):
+        fs = run(
+            """
+            from quest_tpu.contracts import sharded_contract
+
+            @sharded_contract(collectives={"collective-permute": 1},
+                              max_exchange_bytes=512)
+            def swap_sharded(amps):
+                return amps
+            """,
+            path="quest_tpu/parallel/dist.py")
+        assert fs == []
+
+
+class TestParseError:
+    def test_broken_file_reports_parse_error(self):
+        fs = run("def f(:\n")
+        assert rule_ids(fs) == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# Engine mechanics: pragmas, baseline
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressions:
+    SRC = """
+        import time
+
+        def stamp():
+            # qlint: allow(nondeterminism): recorded upstream
+            return time.time()
+        """
+
+    def test_pragma_suppresses_next_line(self):
+        assert run(self.SRC) == []
+
+    def test_pragma_on_same_line_suppresses(self):
+        fs = run(
+            """
+            import time
+
+            def stamp():
+                return time.time()  # qlint: allow(nondeterminism): recorded
+            """)
+        assert fs == []
+
+    def test_reasonless_pragma_is_a_finding(self):
+        fs = run(
+            """
+            import time
+
+            def stamp():
+                # qlint: allow(nondeterminism)
+                return time.time()
+            """)
+        # the bare pragma does NOT suppress, and is itself flagged
+        assert rule_ids(fs) == ["bad-pragma", "nondeterminism"]
+
+    def test_unknown_rule_id_is_a_finding(self):
+        fs = run(
+            """
+            def f():
+                # qlint: allow(no-such-rule): whatever
+                return 1
+            """)
+        assert rule_ids(fs) == ["bad-pragma"]
+        assert "no-such-rule" in fs[0].message
+
+    def test_pragma_in_docstring_does_not_suppress(self):
+        fs = run(
+            '''
+            import time
+
+            def stamp():
+                """Docs may show '# qlint: allow(nondeterminism): x'."""
+                return time.time()
+            ''')
+        assert rule_ids(fs) == ["nondeterminism"]
+
+    def test_wildcard_pragma_suppresses_all(self):
+        fs = run(
+            """
+            import time
+
+            def stamp():
+                # qlint: allow(*): fixture exercising the wildcard
+                return time.time()
+            """)
+        assert fs == []
+
+
+class TestBaseline:
+    def test_reasonless_entry_rejected(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({"findings": [
+            {"rule": "broad-except", "path": "x.py", "line": 3}]}))
+        with pytest.raises(ValueError, match="no reason"):
+            engine.load_baseline(str(p))
+
+    def test_apply_baseline_splits_new_old_stale(self):
+        f1 = engine.Finding("broad-except", "a.py", 3, 1, "m")
+        f2 = engine.Finding("broad-except", "b.py", 9, 1, "m")
+        baseline = [
+            {"rule": "broad-except", "path": "a.py", "line": 3,
+             "reason": "grandfathered"},
+            {"rule": "f64-literal", "path": "gone.py", "line": 1,
+             "reason": "file was deleted"},
+        ]
+        new, old, stale = engine.apply_baseline([f1, f2], baseline)
+        assert new == [f2]
+        assert old == [f1]
+        assert [e["path"] for e in stale] == ["gone.py"]
+
+    def test_committed_baseline_loads_and_is_empty(self):
+        # the tree is clean by construction: the committed baseline must
+        # stay empty (new debt gets fixed or pragma'd, not grandfathered)
+        assert engine.load_baseline() == []
+
+
+# ---------------------------------------------------------------------------
+# The tree itself
+# ---------------------------------------------------------------------------
+
+
+class TestFullTree:
+    def test_zero_unsuppressed_findings(self):
+        findings = engine.analyze_paths()
+        baseline = engine.load_baseline()
+        new, _old, stale = engine.apply_baseline(findings, baseline)
+        assert new == [], "\n".join(f.format() for f in new)
+        assert stale == [], f"stale baseline entries: {stale}"
+
+    def test_all_required_wrappers_registered(self):
+        from quest_tpu.parallel import dist  # noqa: F401 - decorators run
+
+        assert set(C.SHARDED_CONTRACTS) == set(C.REQUIRED_WRAPPERS)
+        for name, contract in C.SHARDED_CONTRACTS.items():
+            assert contract.collectives, name
+            assert contract.max_exchange_bytes > 0, name
+
+
+class TestContractHLO:
+    @pytest.fixture(scope="class")
+    def env8(self):
+        from quest_tpu.analysis import hlocheck
+        try:
+            return hlocheck.ensure_mesh()
+        except RuntimeError as e:
+            pytest.skip(str(e))
+
+    def test_declarations_match_compiled_hlo(self, env8):
+        from quest_tpu.analysis import hlocheck
+        assert hlocheck.verify_sharded_contracts(env=env8) == []
+
+    def test_perturbed_collective_count_fails(self, env8):
+        # drift detection is load-bearing: a declaration that disagrees
+        # with the compiled histogram must FAIL, not quietly pass
+        from quest_tpu.analysis import hlocheck
+        base = C.SHARDED_CONTRACTS["swap_sharded"]
+        perturbed = dict(C.SHARDED_CONTRACTS)
+        perturbed["swap_sharded"] = C.ShardedContract(
+            name="swap_sharded",
+            collectives={"collective-permute": 2},
+            max_exchange_bytes=base.max_exchange_bytes)
+        errors = hlocheck.verify_sharded_contracts(
+            env=env8, contracts=perturbed)
+        assert any("swap_sharded" in e and "collective-permute" in e
+                   for e in errors), errors
+
+    def test_bytes_cap_below_measured_fails(self, env8):
+        from quest_tpu.analysis import hlocheck
+        base = C.SHARDED_CONTRACTS["swap_sharded"]
+        perturbed = dict(C.SHARDED_CONTRACTS)
+        perturbed["swap_sharded"] = C.ShardedContract(
+            name="swap_sharded",
+            collectives=dict(base.collectives),
+            max_exchange_bytes=8)
+        errors = hlocheck.verify_sharded_contracts(
+            env=env8, contracts=perturbed)
+        assert any("swap_sharded" in e and "max_exchange_bytes" in e
+                   for e in errors), errors
+
+    def test_unknown_contract_name_fails(self, env8):
+        from quest_tpu.analysis import hlocheck
+        perturbed = dict(C.SHARDED_CONTRACTS)
+        perturbed["renamed_wrapper"] = C.ShardedContract(
+            name="renamed_wrapper",
+            collectives={"all-gather": 1},
+            max_exchange_bytes=1 << 10)
+        errors = hlocheck.verify_sharded_contracts(
+            env=env8, contracts=perturbed)
+        assert any("renamed_wrapper" in e for e in errors), errors
