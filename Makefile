@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-regress bench docs clean
 
 all: native
 
@@ -31,9 +31,21 @@ test: native
 verify-static:
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m quest_tpu.analysis --contracts
 
+# Multi-tenant serving layer (docs/design.md §24): continuous batcher,
+# admission control, weighted fair scheduling, and the pinned
+# preempt-to-checkpoint bit-identity contract — plus the saturation
+# guard (continuous >= 2x batch-at-once circuits/sec on the same
+# Poisson trace, loaded interactive p99 <= 2x unloaded).  The
+# throughput number itself joins the regression trajectory as
+# bench_suite config 12 (scripts/bench_regress.py normalizes
+# config12:circuits_per_sec from the committed BENCH_r*.json rounds).
+verify-serve:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_serve.py
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static
+verify: verify-static verify-serve
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

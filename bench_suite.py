@@ -625,9 +625,45 @@ def config11():
         telemetry.configure(prev_mode)
 
 
+def config12():
+    """Multi-tenant serving saturation A/B (ISSUE 11): a seeded
+    open-loop Poisson arrival trace replayed against the continuous
+    batcher (quest_tpu.serve.SimServer, window-granular admission +
+    preempt-to-checkpoint) and against batch-at-once per-request
+    EnsembleScheduler drains.  The timing line carries the serving
+    headline (continuous circuits/sec) plus the A/B speedup, bank
+    occupancy, and per-class p50/p99 latency; the >= 2x-throughput /
+    <= 2x-interactive-p99 acceptance gates are the separate
+    scripts/bench_serve.py guard (make verify-serve)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_serve
+
+    n = 8
+    t0 = time.perf_counter()
+    # the trace length is NOT scaled down on CPU: the continuous win
+    # comes from backlog coalescing into full banks, which a short
+    # trace never builds
+    rec = bench_serve.run(n=n, reps=1 if CPU else 2)
+    _set_compile(0.0)  # warm-up/calibration folded into run()'s phases
+    cont = rec["continuous"]
+    _emit(12, f"{n}q continuous-batching serving throughput",
+          cont["circuits_per_sec"], "circuits_per_sec",
+          round(time.perf_counter() - t0, 3),
+          {"speedup_vs_batch_at_once": rec["speedup"],
+           "baseline_circuits_per_sec":
+               rec["baseline"]["circuits_per_sec"],
+           "bank_occupancy_mean": cont["bank_occupancy_mean"],
+           "interactive_p99_ratio": rec["interactive_p99_ratio"],
+           "interactive_e2e": cont.get("interactive", {}).get("e2e"),
+           "preemptions": cont["preemptions"],
+           "resumes": cont["resumes"],
+           "arrival_rate_per_sec": rec["arrival_rate_per_sec"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
+           11: config11, 12: config12}
 
 
 def main():

@@ -50,7 +50,17 @@ from .resilience import (
     FaultPlan,
     SimulatedPreemption,
     NumericalHealthError,
+    WindowExecutor,
     degradation_report,
+)
+from . import serve
+from .serve import (
+    SimServer,
+    Service as SimService,
+    Service,
+    Job,
+    Tenant,
+    QuotaExceededError,
 )
 from .batch import (
     BatchedQureg,

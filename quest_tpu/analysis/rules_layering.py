@@ -7,15 +7,23 @@ imports:
 
     api (api, api_ops, debug, models)          rank 0
       ↓
-    orchestration (fusion, batch, circuit,     rank 1
+    serve (serve)                              rank 1
+      ↓
+    orchestration (fusion, batch, circuit,     rank 2
       resilience, checkpoint, introspect,
       governor)
       ↓
-    dist (parallel/*)                          rank 2
+    dist (parallel/*)                          rank 3
       ↓
-    ops (ops/*)                                rank 3
+    ops (ops/*)                                rank 4
       ↓
-    env (env)                                  rank 4
+    env (env)                                  rank 5
+
+The serve stratum is the orchestration CONSUMER: the multi-tenant
+service composes banks (batch), window stepping + checkpoints
+(resilience), and admission pricing (governor) — so orchestration
+modules importing serve at module level would invert the dependency
+(rank 2 importing rank 1 is flagged as upward).
 
 plus a **shared** stratum (validation, precision, rng, telemetry,
 contracts, qureg, qasm, utils, native, analysis) importable from every
@@ -53,6 +61,7 @@ PACKAGE = "quest_tpu"
 # layer name.  Keep in sync with the diagram in docs/design.md §23.
 LAYER_OF = {
     "api": "api", "api_ops": "api", "debug": "api", "models": "api",
+    "serve": "serve",
     "fusion": "orch", "batch": "orch", "circuit": "orch",
     "resilience": "orch", "checkpoint": "orch", "introspect": "orch",
     "governor": "orch",
@@ -61,7 +70,8 @@ LAYER_OF = {
     "env": "env",
 }
 
-LAYER_RANK = {"api": 0, "orch": 1, "dist": 2, "ops": 3, "env": 4}
+LAYER_RANK = {"api": 0, "serve": 1, "orch": 2, "dist": 3, "ops": 4,
+              "env": 5}
 
 # importable from everywhere; may import only shared + env
 SHARED = {"validation", "precision", "rng", "telemetry", "contracts",

@@ -54,6 +54,8 @@ from .validation import QuESTError
 __all__ = [
     "BatchedQureg",
     "EnsembleScheduler",
+    "bank_gate_items",
+    "bank_occupancy",
     "createBatchedQureg",
     "applyBatchedUnitary",
     "measureBatched",
@@ -400,14 +402,21 @@ def _bucket_size(count: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
-def bank_occupancy(qureg) -> dict:
+def bank_occupancy(qureg, real: Optional[int] = None) -> dict:
     """Bucket occupancy of a batched register for the plan explainer
     (introspect.explain_circuit): the live batch size, the power-of-two
     bucket it pads to, and the real/padded fraction — the same quantity
-    EnsembleScheduler publishes as the ``batch_occupancy`` gauge."""
+    EnsembleScheduler publishes as the ``batch_occupancy`` gauge.
+
+    ``real`` (serving layer): the bank was ALREADY padded to a
+    power-of-two batch and only ``real`` of its elements carry live
+    jobs — report true occupancy with the padding excluded."""
     bsz = int(getattr(qureg, "batch_size", 0) or 0)
     if not bsz:
         return {"size": 0, "bucket": 0, "occupancy": 1.0}
+    if real is not None:
+        return {"size": int(real), "bucket": bsz,
+                "occupancy": int(real) / bsz}
     bucket = _bucket_size(bsz, 1 << 30)
     return {"size": bsz, "bucket": bucket, "occupancy": bsz / bucket}
 
@@ -422,6 +431,54 @@ def _structure_fingerprint(gates: Sequence, num_qubits: int,
         m = np.asarray(g.mat)
         parts.append((tuple(g.targets), m.shape[-1]))
     return tuple(parts)
+
+
+def bank_gate_items(streams: Sequence[Sequence], num_qubits: int,
+                    is_density: bool, *, qureg=None) -> List:
+    """Fuse B same-STRUCTURE gate streams into ONE bank item list.
+
+    ``streams[b]`` is submission b's gate sequence; all B must share a
+    structural fingerprint (same targets and matrix shapes gate for
+    gate).  Gate j collapses to one shared (2, s, s) item when every
+    element's matrix is bitwise identical, else stacks to a per-element
+    (B, 2, s, s) item (the applyBatchedUnitary representation); density
+    banks get the conjugated bra twin after each item.  The result is
+    appendable to a :class:`BatchedQureg`'s fusion buffer — the shared
+    path of ``EnsembleScheduler._run_bucket`` and the window-stepped
+    banks of :mod:`quest_tpu.serve` build their programs through here.
+
+    ``qureg``: when given, each gate is validated against the fused
+    path's capture limits (batched registers have no eager fallback).
+    """
+    B = len(streams)
+    items: List = []
+    for j in range(len(streams[0])):
+        mats = [np.asarray(s[j].mat) for s in streams]
+        targets = tuple(int(t) for t in streams[0][j].targets)
+        if qureg is not None and (
+                not _fusion._capturable(qureg, targets) or (
+                    is_density and not _fusion._capturable(
+                        qureg, tuple(t + num_qubits for t in targets)))):
+            raise QuESTError(
+                "bank_gate_items: gate does not qualify for the fused "
+                f"path (<= {_fusion.FUSION_MAX_GATE_QUBITS} qubits, and "
+                "shard-local space for a distributed bank) — batched "
+                "registers have no eager fallback")
+        if all(m.tobytes() == mats[0].tobytes() for m in mats[1:]):
+            shared = mats[0]
+            items.append(C.Gate(targets, shared))
+            if is_density:
+                items.append(C.Gate(
+                    tuple(t + num_qubits for t in targets),
+                    np.stack([shared[0], -shared[1]])))
+        else:
+            stacked = _soa_per_element(np.stack(mats), B)
+            items.append(C.Gate(targets, stacked))
+            if is_density:
+                items.append(C.Gate(
+                    tuple(t + num_qubits for t in targets),
+                    np.stack([stacked[:, 0], -stacked[:, 1]], axis=1)))
+    return items
 
 
 class EnsembleScheduler:
@@ -469,8 +526,10 @@ class EnsembleScheduler:
         self._pending.append((sid, fp, gates, seed))
         return sid
 
-    def _run_bucket(self, group: list) -> dict:
-        """Execute one fingerprint group bucket; returns {sid: amps}."""
+    def _run_bucket(self, group: list) -> Tuple[dict, int, int]:
+        """Execute one fingerprint group bucket; returns
+        ({sid: amps}, real, padded) so ``drain()`` can aggregate TRUE
+        occupancy (padding excluded) across buckets."""
         real = len(group)
         B = _bucket_size(real, self.max_batch)
         # pad with copies of the last submission: padding elements run
@@ -481,30 +540,24 @@ class EnsembleScheduler:
         q = createBatchedQureg(
             self.num_qubits, self.env, B,
             is_density_matrix=self.is_density_matrix, seeds=seeds)
-        ngates = len(group[0][2])
-        for j in range(ngates):
-            mats = [np.asarray(sub[2][j].mat) for sub in padded]
-            targets = group[0][2][j].targets
-            if all(m.tobytes() == mats[0].tobytes() for m in mats[1:]):
-                from . import api as _api
+        from . import api as _api
 
-                _telemetry.inc_key(_api._K_UNITARY, B)
-                q._fusion.gates.append(C.Gate(tuple(targets), mats[0]))
-                if self.is_density_matrix:
-                    sh = self.num_qubits
-                    q._fusion.gates.append(C.Gate(
-                        tuple(t + sh for t in targets),
-                        np.stack([mats[0][0], -mats[0][1]])))
-            else:
-                applyBatchedUnitary(q, targets, np.stack(mats))
+        items = bank_gate_items([sub[2] for sub in padded],
+                                self.num_qubits, self.is_density_matrix,
+                                qureg=q)
+        _telemetry.inc_key(_api._K_UNITARY, B * len(group[0][2]))
+        q._fusion.gates.extend(items)
         bank = np.asarray(q.amps)
-        _telemetry.set_gauge("batch_occupancy", real / B)
         _telemetry.observe("ensemble_bucket_occupancy", real / B)
-        return {sub[0]: bank[i] for i, sub in enumerate(group)}
+        return {sub[0]: bank[i] for i, sub in enumerate(group)}, real, B
 
     def drain(self) -> List[np.ndarray]:
         """Run every pending submission; returns final canonical
-        amplitudes in submission order and clears the queue."""
+        amplitudes in submission order and clears the queue.  The
+        ``batch_occupancy`` gauge is set ONCE per drain to the
+        aggregate real/padded fraction over every bucket run — a
+        partially-filled trailing bucket no longer overwrites the gauge
+        with its own (lower or higher) ratio."""
         if not self._pending:
             return []
         t0 = time.perf_counter()
@@ -513,12 +566,18 @@ class EnsembleScheduler:
         for sub in pending:
             groups.setdefault(sub[1], []).append(sub)
         results: dict = {}
+        occ_real = occ_padded = 0
         with _telemetry.span("batch.ensemble_drain",
                              circuits=len(pending), groups=len(groups)):
             for group in groups.values():
                 for i in range(0, len(group), self.max_batch):
-                    results.update(self._run_bucket(
-                        group[i:i + self.max_batch]))
+                    res, real, padded = self._run_bucket(
+                        group[i:i + self.max_batch])
+                    results.update(res)
+                    occ_real += real
+                    occ_padded += padded
+        if occ_padded:
+            _telemetry.set_gauge("batch_occupancy", occ_real / occ_padded)
         dt = time.perf_counter() - t0
         _telemetry.inc("ensemble_circuits_total", len(pending))
         if dt > 0:

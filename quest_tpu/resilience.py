@@ -630,6 +630,97 @@ def load_latest(ckpt_dir: str, env, *, strict_mesh: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# Window-stepping executor (shared by run_resumable and quest_tpu.serve)
+# ---------------------------------------------------------------------------
+
+
+class WindowExecutor:
+    """Drive a gate stream on a register ONE fusion window at a time.
+
+    The window boundaries come from
+    :func:`quest_tpu.circuit.plan_checkpoint_boundaries` — the safe
+    yield points where no fused pass is mid-flight, so between any two
+    :meth:`step` calls the register can be checkpointed, preempted, or
+    interleaved with other work.  Two consumers share this loop:
+
+    - :func:`run_resumable` steps an executor to completion, wrapping
+      every window with the watchdog and a committed checkpoint
+      generation (``_execute_windows``);
+    - :class:`quest_tpu.serve.SimServer` interleaves the windows of MANY
+      executors under a fair scheduler (continuous batching), calling
+      :meth:`checkpoint` only when a bank is preempted.
+
+    ``step()`` fires the window's armed faults (kill before execute,
+    exchange faults at dispatch time) exactly as run_resumable's loop
+    always has, so FaultPlan schedules apply unchanged to served banks.
+    """
+
+    def __init__(self, qureg, gates: Sequence, *, every: int,
+                 start: int = 0, faults: Optional[FaultPlan] = None,
+                 fingerprint: str = ""):
+        from . import circuit as C
+
+        if every < 1:
+            raise QuESTError("WindowExecutor: every must be >= 1")
+        self.qureg = qureg
+        self.gates = [g if isinstance(g, C.Gate)
+                      else C.Gate(tuple(g[0]), g[1]) for g in gates]
+        self.every = int(every)
+        self.faults = faults
+        self.fingerprint = fingerprint
+        self.cursor = int(start)
+        self._boundaries = C.plan_checkpoint_boundaries(
+            len(self.gates), self.every, start=self.cursor)
+        self._bi = 0
+
+    @property
+    def done(self) -> bool:
+        return self._bi >= len(self._boundaries)
+
+    @property
+    def window(self) -> int:
+        """Index of the NEXT window to execute (gates
+        [window*every, (window+1)*every))."""
+        return self.cursor // self.every
+
+    @property
+    def num_windows(self) -> int:
+        return len(self._boundaries)
+
+    def step(self) -> int:
+        """Execute one window [cursor, next boundary) as a single fused
+        drain and advance the cursor.  Returns the new cursor.  No-op at
+        the end of the stream."""
+        from . import fusion as _fusion
+
+        if self.done:
+            return self.cursor
+        end = self._boundaries[self._bi]
+        if self.faults is not None:
+            self.faults.maybe_kill(self.window)
+            self.faults.arm_exchange_window(self.window)
+        _fusion.start_gate_fusion(self.qureg)
+        try:
+            self.qureg._fusion.gates.extend(self.gates[self.cursor:end])
+        finally:
+            _fusion.stop_gate_fusion(self.qureg)  # drain: the window pass
+        self.cursor = end
+        self._bi += 1
+        return end
+
+    def checkpoint(self, ckpt_dir: str) -> str:
+        """Commit a generation of the register at the CURRENT cursor (a
+        window boundary) — the preempt-to-checkpoint half of serve's
+        preemption protocol; resume via :func:`load_latest` +
+        :func:`_restore_into` and a fresh executor with
+        ``start=cursor``."""
+        window = max(0, (self.cursor - 1) // self.every)
+        return save_generation(self.qureg, ckpt_dir, self.cursor,
+                               fingerprint=self.fingerprint,
+                               faults=self.faults, window=window)
+
+
+# ---------------------------------------------------------------------------
 # Resumable driver
 # ---------------------------------------------------------------------------
 
@@ -731,38 +822,31 @@ def _execute_windows(qureg, glist, ckpt_dir: str, *, every: int,
                      marks: dict) -> None:
     """One pass of run_resumable's window loop from gate ``start`` to the
     end of ``glist`` on qureg's CURRENT mesh — factored out so the
-    failover path can re-enter it after a rollback + mesh shrink."""
-    from . import circuit as C
-    from . import fusion as _fusion
-
-    boundaries = C.plan_checkpoint_boundaries(len(glist), every, start=start)
-    cursor = start
-    for end in boundaries:
-        window = cursor // every
-        if faults is not None:
-            faults.maybe_kill(window)
-            faults.arm_exchange_window(window)
+    failover path can re-enter it after a rollback + mesh shrink.  The
+    window stepping itself is :class:`WindowExecutor` (shared with the
+    serving layer); this wrapper adds the watchdog, fault-driven
+    amplitude corruption, and a committed checkpoint after EVERY window.
+    """
+    ex = WindowExecutor(qureg, glist, every=every, start=start,
+                        faults=faults, fingerprint=fp)
+    while not ex.done:
+        window = ex.window
+        begin = ex.cursor
         marks["window_started"] = time.perf_counter()
-        _fusion.start_gate_fusion(qureg)
-        try:
-            qureg._fusion.gates.extend(glist[cursor:end])
-        finally:
-            _fusion.stop_gate_fusion(qureg)  # drain: the window pass
+        end = ex.step()
         if marks["resume_from"] is not None:
             _telemetry.set_gauge("failover_resume_seconds",
                                  time.perf_counter() - marks["resume_from"])
             marks["resume_from"] = None
         if faults is not None:
             faults.maybe_corrupt_amps(qureg, window)
-        _watchdog_step(qureg, ckpt_dir, watchdog, (cursor, end),
+        _watchdog_step(qureg, ckpt_dir, watchdog, (begin, end),
                        log_ctx=(run_id, t_run))
-        cursor = end
         t_ck = time.perf_counter()
         with _telemetry.span("resilience.checkpoint", window=window):
-            save_generation(qureg, ckpt_dir, cursor, fingerprint=fp,
-                            faults=faults, window=window)
-        _log_event(run_id, "checkpoint", window=window, cursor=cursor,
-                   generation=_gen_name(cursor),
+            ex.checkpoint(ckpt_dir)
+        _log_event(run_id, "checkpoint", window=window, cursor=end,
+                   generation=_gen_name(end),
                    seconds=round(time.perf_counter() - t_ck, 4),
                    elapsed=round(time.perf_counter() - t_run, 4))
 

@@ -1,0 +1,831 @@
+"""Multi-tenant simulation service: continuous batching with admission
+control and preemption.
+
+The platform layers shipped so far — structure-fingerprinted ensemble
+banks (batch.py), window-granular resumable execution (resilience.py),
+analytic HBM admission (governor.py), and labeled telemetry — are
+composed here into the serving front end the ROADMAP's north star asks
+for: many small heterogeneous circuits from many tenants, arriving as an
+open-loop stream, kept saturating the device.  qHiPSTER and mpiQulacs
+(PAPERS.md) both stop at throughput-oriented *batch* engines; the piece
+they lack, borrowed from LLM serving, is CONTINUOUS batching — admission
+of new work between fusion windows of work already in flight, instead of
+batch-at-once draining.
+
+**Execution model.**  :class:`SimServer` is a synchronous scheduling
+core driven by repeated :meth:`SimServer.step` calls (the asyncio front
+end :class:`Service` just steps it between awaits).  One step advances
+exactly ONE fusion window of ONE bank:
+
+- submitted jobs land in structure-fingerprinted **buckets** (the
+  EnsembleScheduler grouping, extended with the measurement schedule);
+- each bucket coalesces waiting jobs into a **bank** — a
+  :class:`~quest_tpu.batch.BatchedQureg` padded to a power-of-two batch
+  — which stays OPEN (absorbing late arrivals at no cost) until its
+  first window executes;
+- a started bank advances through a
+  :class:`~quest_tpu.resilience.WindowExecutor`, the window-stepping
+  loop shared with ``run_resumable``, so between any two windows the
+  scheduler can switch banks, admit arrivals, or checkpoint.
+
+Because every bank element shares one program cursor, continuous
+batching happens at window granularity: arrivals coalesce into the next
+bank of their bucket while the current banks execute, and no arrival
+ever waits for a full system drain (the batch-at-once failure mode
+``scripts/bench_serve.py`` quantifies).
+
+**Scheduling policy.**  Two strict priority classes — ``interactive``
+before ``batch`` — and weighted fair queuing within a class: each
+tenant carries a virtual time advanced by ``window / weight`` whenever
+a bank holding its jobs runs, and the runnable bank whose owning
+tenants have the smallest virtual time goes next (stride scheduling;
+an idle tenant's vtime catches up to the clock on its next submit, so
+idle periods bank no credit).
+
+**Admission control.**  ``submit`` is the backpressure point; it raises
+a structured :class:`QuotaExceededError` (never queues unboundedly)
+when the global queue is full, the tenant's pending cap is hit, the
+tenant's in-flight analytic bytes exceed its quota, or the job could
+never fit the governor's HBM budget — the same ``B x 2 x 2^n x
+itemsize`` pricing ``governor.admit_new`` applies at register creation.
+
+**Preemption.**  When an interactive bank is runnable while batch banks
+hold device memory mid-flight, the batch banks are preempted AT THEIR
+CURRENT WINDOW BOUNDARY — the executor's cursor is always at one
+between steps — via the resilience generation protocol
+(``preempt="checkpoint"``: commit a generation, drop the device bank)
+or kept resident but descheduled (``preempt="pause"``).  Resume reloads
+the generation (raw permuted amplitudes, live perm, per-element
+measurement key/shot bank) and continues bit-identically to an
+uninterrupted run; tests/test_serve.py pins that equivalence.
+
+Environment knobs (all optional, constructor args win):
+
+- ``QT_SERVE_WINDOW``       gates per fusion window        (default 16)
+- ``QT_SERVE_MAX_BATCH``    bank size cap, power of two    (default 16)
+- ``QT_SERVE_MAX_PENDING``  global queued-job cap          (default 1024)
+- ``QT_SERVE_PREEMPT``      checkpoint | pause | off       (default checkpoint)
+- ``QT_SERVE_CKPT_DIR``     preemption checkpoint root     (default: temp dir)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import batch as _batch
+from . import circuit as C
+from . import governor as _governor
+from . import resilience as _resilience
+from . import telemetry as _telemetry
+from .env import QuESTEnv
+from .validation import QuESTError
+
+__all__ = [
+    "INTERACTIVE",
+    "BATCH",
+    "Job",
+    "QuotaExceededError",
+    "Service",
+    "SimServer",
+    "Tenant",
+]
+
+# priority classes, strict order: interactive preempts batch
+INTERACTIVE = "interactive"
+BATCH = "batch"
+_PRIORITIES = (INTERACTIVE, BATCH)
+_CLASS_RANK = {INTERACTIVE: 0, BATCH: 1}
+
+# job states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+_PREEMPT_MODES = ("checkpoint", "pause", "off")
+
+_WINDOW_ENV = "QT_SERVE_WINDOW"
+_MAX_BATCH_ENV = "QT_SERVE_MAX_BATCH"
+_MAX_PENDING_ENV = "QT_SERVE_MAX_PENDING"
+_PREEMPT_ENV = "QT_SERVE_PREEMPT"
+_CKPT_DIR_ENV = "QT_SERVE_CKPT_DIR"
+
+
+class QuotaExceededError(QuESTError):
+    """A submission was refused by admission control — the structured
+    backpressure signal (HTTP-429 analogue).  ``kind`` names the
+    exhausted resource:
+
+    - ``backpressure`` — the server's global queued-job cap;
+    - ``pending``      — the tenant's queued+running job cap;
+    - ``bytes``        — the tenant's in-flight analytic byte quota;
+    - ``memory``       — the job could never fit the governor's
+      per-device HBM budget (governor.admit_new pricing).
+
+    Carries the numbers so clients can implement informed retry."""
+
+    def __init__(self, msg: str, *, tenant: str, kind: str,
+                 limit: float, value: float):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.kind = kind
+        self.limit = limit
+        self.value = value
+
+
+class Tenant:
+    """Per-tenant scheduling state: fair-share ``weight`` (bigger =
+    more windows per unit virtual time), a queued+running job cap, and
+    an optional analytic in-flight byte quota priced exactly as the
+    governor prices registers."""
+
+    def __init__(self, name: str, *, weight: float = 1.0,
+                 max_pending: int = 64,
+                 max_bytes: Optional[int] = None):
+        if weight <= 0:
+            raise QuESTError(
+                f"Tenant: weight must be > 0, got {weight}")
+        self.name = str(name)
+        self.weight = float(weight)
+        self.max_pending = int(max_pending)
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        self.vtime = 0.0          # weighted-fair virtual time
+        self.inflight = 0         # queued + running jobs
+        self.inflight_bytes = 0   # analytic bytes of those jobs
+        self.submitted = 0
+        self.completed = 0
+
+    def __repr__(self):
+        return (f"Tenant({self.name!r}, weight={self.weight}, "
+                f"inflight={self.inflight}, vtime={self.vtime:.3f})")
+
+
+class Job:
+    """One submitted circuit.  Lifecycle: ``queued`` -> ``running``
+    (its bank's first window executed) -> ``done`` / ``failed``.  On
+    completion :attr:`amps` holds the element's canonical (2, 2^n)
+    amplitudes (post-measurement when a measurement schedule was
+    given), :attr:`outcomes` the per-measured-qubit ``(outcome,
+    probability)`` pairs in schedule order, and :attr:`key_state` the
+    element's final measurement key/shot-counter pair — the serving
+    analogue of BatchedQureg.key_state, recorded so clients (and the
+    preemption bit-identity tests) can audit the RNG stream."""
+
+    __slots__ = ("id", "tenant", "gates", "num_qubits", "priority",
+                 "seed", "measure", "state", "amps", "outcomes",
+                 "key_state", "error", "bytes", "t_submit", "t_start",
+                 "t_done")
+
+    def __init__(self, jid: int, tenant: str, gates: list,
+                 num_qubits: int, priority: str, seed, measure: tuple,
+                 nbytes: int):
+        self.id = jid
+        self.tenant = tenant
+        self.gates = gates
+        self.num_qubits = num_qubits
+        self.priority = priority
+        self.seed = seed
+        self.measure = measure
+        self.bytes = nbytes
+        self.state = QUEUED
+        self.amps = None
+        self.outcomes: List[Tuple[int, float]] = []
+        self.key_state: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_start: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in (DONE, FAILED)
+
+    def result(self):
+        """The final amplitudes, re-raising the job's failure (and
+        refusing while the job is still in flight)."""
+        if self.state == FAILED:
+            raise self.error
+        if self.state != DONE:
+            raise QuESTError(
+                f"Job {self.id}: result() before completion "
+                f"(state={self.state!r}) — drive the server "
+                "(step/run_until_idle) or await Service.wait")
+        return self.amps
+
+    def __repr__(self):
+        return (f"Job(id={self.id}, tenant={self.tenant!r}, "
+                f"priority={self.priority!r}, state={self.state!r})")
+
+
+class _Bank:
+    """One padded batch of same-fingerprint jobs moving through a
+    WindowExecutor.  OPEN until its first window (jobs may still join);
+    then RUNNING, possibly PREEMPTED (device state checkpointed and
+    dropped, or just descheduled under ``pause``), and finally drained
+    + finalized."""
+
+    __slots__ = ("seq", "key", "jobs", "num_qubits", "is_density",
+                 "measure", "priority", "qureg", "ex", "items", "B",
+                 "started", "preempted", "paused", "cursor", "sfp",
+                 "ckpt_dir")
+
+    def __init__(self, seq: int, key: tuple, num_qubits: int,
+                 is_density: bool, measure: tuple):
+        self.seq = seq
+        self.key = key
+        self.jobs: List[Job] = []
+        self.num_qubits = num_qubits
+        self.is_density = is_density
+        self.measure = measure
+        self.priority = BATCH
+        self.qureg = None
+        self.ex: Optional[_resilience.WindowExecutor] = None
+        self.items: Optional[list] = None
+        self.B = 0
+        self.started = False
+        self.preempted = False
+        self.paused = False
+        self.cursor = 0
+        self.sfp = ""
+        self.ckpt_dir = ""
+
+    def add(self, job: Job) -> None:
+        self.jobs.append(job)
+        if _CLASS_RANK[job.priority] < _CLASS_RANK[self.priority]:
+            self.priority = job.priority
+
+    @property
+    def running(self) -> bool:
+        return self.started and self.ex is not None \
+            and not self.ex.done
+
+    def min_vtime(self, tenants: Dict[str, Tenant]) -> float:
+        return min(tenants[j.tenant].vtime for j in self.jobs)
+
+
+def _env_int(var: str, default: int) -> int:
+    raw = os.environ.get(var, "").strip()
+    return int(raw) if raw else default
+
+
+def _job_bytes_per_device(num_qubits: int, env: QuESTEnv,
+                          is_density: bool, batch: int = 1) -> int:
+    """Analytic per-device footprint of ``batch`` elements of an
+    ``num_qubits``-qubit register — the same ``B x 2 x 2^n x itemsize``
+    model ``governor.register_bytes_per_device`` applies, computed from
+    parameters so admission can price a job BEFORE any register
+    exists."""
+    from . import precision as P
+
+    n = num_qubits * (2 if is_density else 1)
+    amps = 1 << n
+    total = batch * 2 * amps * np.dtype(P.real_dtype()).itemsize
+    if env.mesh is not None and amps >= env.num_devices:
+        return total // env.num_devices
+    return total
+
+
+class SimServer:
+    """The synchronous multi-tenant scheduling core (see the module
+    docstring for the execution model).  Drive it with :meth:`step`
+    (one window of one bank) or :meth:`run_until_idle`; wrap it in
+    :class:`Service` for an asyncio front end.
+
+    Parameters default from the ``QT_SERVE_*`` environment knobs;
+    explicit arguments win.  ``max_batch`` must be a power of two (the
+    EnsembleScheduler bucket rule, bounding jit retraces per structure
+    by the bucket count)."""
+
+    def __init__(self, env: QuESTEnv, *, window: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 max_pending: Optional[int] = None,
+                 preempt: Optional[str] = None,
+                 ckpt_dir: Optional[str] = None):
+        self.env = env
+        self.window = window if window is not None \
+            else _env_int(_WINDOW_ENV, 16)
+        self.max_batch = max_batch if max_batch is not None \
+            else _env_int(_MAX_BATCH_ENV, 16)
+        self.max_pending = max_pending if max_pending is not None \
+            else _env_int(_MAX_PENDING_ENV, 1024)
+        self.preempt = preempt if preempt is not None \
+            else (os.environ.get(_PREEMPT_ENV, "").strip()
+                  or "checkpoint")
+        if self.window < 1:
+            raise QuESTError(
+                f"SimServer: window must be >= 1, got {self.window}")
+        if self.max_batch < 1 or (self.max_batch & (self.max_batch - 1)):
+            raise QuESTError(
+                f"SimServer: max_batch must be a power of two, got "
+                f"{self.max_batch}")
+        if self.preempt not in _PREEMPT_MODES:
+            raise QuESTError(
+                f"SimServer: unknown preempt mode {self.preempt!r} "
+                f"(expected one of {_PREEMPT_MODES})")
+        root = ckpt_dir or os.environ.get(_CKPT_DIR_ENV, "").strip()
+        self._own_ckpt_root = not root
+        self._ckpt_root = root or tempfile.mkdtemp(prefix="qt_serve_")
+        self.tenants: Dict[str, Tenant] = {}
+        self._buckets: Dict[tuple, List[Job]] = {}
+        self._banks: List[_Bank] = []
+        self._next_job = 0
+        self._next_bank = 0
+        self._vclock = 0.0
+        self._queued = 0
+        self._closed = False
+        self.completed = 0
+
+    # -- tenants ---------------------------------------------------------
+
+    def register_tenant(self, name: str, *, weight: float = 1.0,
+                        max_pending: int = 64,
+                        max_bytes: Optional[int] = None) -> Tenant:
+        """Create (or reconfigure) a tenant.  Unregistered tenant names
+        are auto-created at first submit with default limits."""
+        t = self.tenants.get(name)
+        if t is None:
+            t = Tenant(name, weight=weight, max_pending=max_pending,
+                       max_bytes=max_bytes)
+            t.vtime = self._vclock
+            self.tenants[name] = t
+        else:
+            t.weight = float(weight)
+            t.max_pending = int(max_pending)
+            t.max_bytes = None if max_bytes is None else int(max_bytes)
+        return t
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, gates: Sequence, *, num_qubits: int,
+               tenant: str = "default", priority: str = BATCH,
+               seed=None, measure: Sequence[int] = (),
+               is_density_matrix: bool = False) -> Job:
+        """Queue one circuit for execution; returns its :class:`Job`
+        handle.  ``gates`` is a sequence of
+        :class:`quest_tpu.circuit.Gate` with concrete numpy SoA
+        matrices (the EnsembleScheduler submission format);
+        ``measure`` optionally schedules qubit measurements (in order)
+        after the last gate — part of the batching fingerprint, so only
+        identically-measured circuits share a bank.  ``seed`` gives the
+        element its measurement stream (default: the job id).
+
+        Raises :class:`QuotaExceededError` instead of queueing beyond
+        any limit — admission control IS the backpressure."""
+        if self._closed:
+            raise QuESTError("SimServer: submit after close()")
+        if priority not in _PRIORITIES:
+            raise QuESTError(
+                f"SimServer.submit: unknown priority {priority!r} "
+                f"(expected one of {_PRIORITIES})")
+        gates = [g if isinstance(g, C.Gate) else C.Gate(tuple(g[0]), g[1])
+                 for g in gates]
+        for g in gates:
+            if not isinstance(g.mat, np.ndarray):
+                raise QuESTError(
+                    "SimServer.submit: gate matrices must be concrete "
+                    "numpy arrays (traced values cannot be stacked "
+                    "across submissions)")
+        t = self.tenants.get(tenant)
+        if t is None:
+            t = self.register_tenant(tenant)
+        if self._queued >= self.max_pending:
+            self._reject(t, "backpressure", self.max_pending,
+                         self._queued)
+        if t.inflight >= t.max_pending:
+            self._reject(t, "pending", t.max_pending, t.inflight)
+        nbytes = _job_bytes_per_device(int(num_qubits), self.env,
+                                       is_density_matrix)
+        if t.max_bytes is not None \
+                and t.inflight_bytes + nbytes > t.max_bytes:
+            self._reject(t, "bytes", t.max_bytes,
+                         t.inflight_bytes + nbytes)
+        budget = _governor.budget_bytes()
+        if _governor.enabled() and budget is not None \
+                and nbytes > budget:
+            self._reject(t, "memory", budget, nbytes)
+        measure = tuple(int(m) for m in measure)
+        for qb in measure:
+            if not 0 <= qb < int(num_qubits):
+                raise QuESTError(
+                    f"SimServer.submit: measured qubit {qb} out of "
+                    f"range for {num_qubits} qubits")
+        jid = self._next_job
+        self._next_job += 1
+        job = Job(jid, t.name, gates, int(num_qubits), priority,
+                  seed, measure, nbytes)
+        key = (_batch._structure_fingerprint(
+            gates, int(num_qubits), bool(is_density_matrix)),
+            job.measure)
+        self._buckets.setdefault(key, []).append(job)
+        # an idle tenant's vtime catches up to the scheduler clock so
+        # idle periods bank no fair-share credit
+        t.vtime = max(t.vtime, self._vclock)
+        t.inflight += 1
+        t.inflight_bytes += nbytes
+        t.submitted += 1
+        self._queued += 1
+        _telemetry.inc("serve_jobs_submitted_total", tenant=t.name)
+        _telemetry.set_gauge("serve_queue_depth", self._queued)
+        return job
+
+    def _reject(self, t: Tenant, kind: str, limit, value) -> None:
+        _telemetry.inc("serve_jobs_rejected_total", tenant=t.name,
+                       kind=kind)
+        raise QuotaExceededError(
+            f"SimServer.submit: tenant {t.name!r} over {kind} limit "
+            f"({value} > {limit}) — back off and retry",
+            tenant=t.name, kind=kind, limit=float(limit),
+            value=float(value))
+
+    # -- continuous batching: bucket -> bank coalescing ------------------
+
+    def _form_banks(self) -> None:
+        """Move waiting jobs into banks.  A bucket's newest bank stays
+        OPEN (absorbing arrivals) until its first window executes —
+        this is the continuous-batching admission point: work arriving
+        while other banks execute coalesces here instead of waiting for
+        a global drain."""
+        for key, waiting in self._buckets.items():
+            if not waiting:
+                continue
+            bank = next((b for b in self._banks
+                         if b.key == key and not b.started
+                         and len(b.jobs) < self.max_batch), None)
+            if bank is None:
+                sfp, measure = key
+                bank = _Bank(self._next_bank, key,
+                             num_qubits=waiting[0].num_qubits,
+                             is_density=bool(sfp[0][2]), measure=measure)
+                self._next_bank += 1
+                self._banks.append(bank)
+            room = self.max_batch - len(bank.jobs)
+            for job in waiting[:room]:
+                bank.add(job)
+            del waiting[:room]
+
+    def _start(self, bank: _Bank) -> None:
+        """Close an open bank: pad to a power-of-two batch, build the
+        fused bank program (shared-matrix collapse / per-element
+        stacking), create the governed register, and arm its
+        WindowExecutor."""
+        jobs = bank.jobs
+        real = len(jobs)
+        bank.B = _batch._bucket_size(real, self.max_batch)
+        padded = jobs + [jobs[-1]] * (bank.B - real)
+        seeds = [j.seed if j.seed is not None else j.id for j in padded]
+        q = _batch.createBatchedQureg(
+            bank.num_qubits, self.env, bank.B,
+            is_density_matrix=bank.is_density, seeds=seeds)
+        bank.items = _batch.bank_gate_items(
+            [j.gates for j in padded], bank.num_qubits,
+            bank.is_density, qureg=q)
+        from . import api as _api
+
+        _telemetry.inc_key(_api._K_UNITARY,
+                           bank.B * len(jobs[0].gates))
+        bank.sfp = _resilience.circuit_fingerprint(
+            bank.items, q.num_qubits_in_state_vec, self.window)
+        bank.ckpt_dir = os.path.join(self._ckpt_root,
+                                     f"bank-{bank.seq}")
+        bank.qureg = q
+        bank.ex = _resilience.WindowExecutor(
+            q, bank.items, every=self.window, fingerprint=bank.sfp)
+        bank.started = True
+        now = time.perf_counter()
+        for j in jobs:
+            j.state = RUNNING
+            j.t_start = now
+            self._queued -= 1
+            _telemetry.observe("serve_queue_wait_seconds",
+                               now - j.t_submit, tenant=j.tenant)
+        _telemetry.inc("serve_banks_total")
+        _telemetry.set_gauge("serve_queue_depth", self._queued)
+        self._publish_occupancy(bank)
+
+    def _publish_occupancy(self, bank: _Bank) -> None:
+        occ = _batch.bank_occupancy(bank.qureg, real=len(bank.jobs))
+        _telemetry.set_gauge("serve_bank_occupancy", occ["occupancy"])
+        _telemetry.observe("ensemble_bucket_occupancy",
+                           occ["occupancy"])
+        per_tenant: Dict[str, int] = {}
+        for j in bank.jobs:
+            per_tenant[j.tenant] = per_tenant.get(j.tenant, 0) + 1
+        for name, count in per_tenant.items():
+            _telemetry.set_gauge("bank_occupancy", count / bank.B,
+                                 tenant=name)
+
+    # -- preemption protocol ---------------------------------------------
+
+    def _preempt(self, bank: _Bank) -> None:
+        """Preempt a mid-flight bank at its current window boundary.
+        ``checkpoint`` mode commits a resilience generation (raw
+        permuted amplitudes + live perm + per-element key/shot bank)
+        and DROPS the device state, freeing its governed footprint;
+        ``pause`` mode merely deschedules (state stays resident)."""
+        if self.preempt == "off" or not bank.running or bank.paused:
+            return
+        _telemetry.inc("preemptions_total", mode=self.preempt)
+        if self.preempt == "pause":
+            bank.paused = True
+            return
+        with _telemetry.span("serve.preempt", bank=bank.seq):
+            bank.ex.checkpoint(bank.ckpt_dir)
+        bank.cursor = bank.ex.cursor
+        _governor.release(bank.qureg)
+        bank.qureg = None
+        bank.ex = None
+        bank.preempted = True
+
+    def _resume(self, bank: _Bank) -> None:
+        """Reload a checkpoint-preempted bank and continue from its
+        saved cursor — the other half of the bit-identical preemption
+        contract."""
+        with _telemetry.span("serve.resume", bank=bank.seq):
+            loaded = _resilience.load_latest(bank.ckpt_dir, self.env)
+        if loaded is None:
+            raise QuESTError(
+                f"SimServer: preempted bank {bank.seq} has no loadable "
+                f"generation under {bank.ckpt_dir}")
+        q, meta = loaded
+        cursor = int(meta.get("cursor", 0))
+        if cursor != bank.cursor:
+            raise QuESTError(
+                f"SimServer: bank {bank.seq} checkpoint cursor "
+                f"{cursor} != preemption cursor {bank.cursor}")
+        bank.qureg = q
+        bank.ex = _resilience.WindowExecutor(
+            q, bank.items, every=self.window, start=cursor,
+            fingerprint=bank.sfp)
+        bank.preempted = False
+        _telemetry.inc("serve_resumes_total")
+
+    # -- scheduling ------------------------------------------------------
+
+    def _runnable(self) -> List[_Bank]:
+        return [b for b in self._banks
+                if b.jobs and (not b.started or b.preempted
+                               or b.paused or b.running)]
+
+    def _pick(self) -> Optional[_Bank]:
+        """Strict priority class, then weighted fair (smallest owning
+        vtime), then bank age."""
+        runnable = self._runnable()
+        if not runnable:
+            return None
+        return min(runnable, key=lambda b: (
+            _CLASS_RANK[b.priority], b.min_vtime(self.tenants), b.seq))
+
+    def _charge(self, bank: _Bank) -> None:
+        per_tenant: Dict[str, int] = {}
+        for j in bank.jobs:
+            per_tenant[j.tenant] = per_tenant.get(j.tenant, 0) + 1
+        for name, count in per_tenant.items():
+            t = self.tenants[name]
+            t.vtime += (count / len(bank.jobs)) / t.weight
+            self._vclock = max(self._vclock, t.vtime)
+
+    def step(self) -> bool:
+        """One scheduling quantum: coalesce arrivals into banks, pick
+        the next bank under the policy, preempt lower-priority work if
+        the pick is interactive, and advance the pick by ONE fusion
+        window (finalizing it when the stream ends).  Returns False
+        when nothing is runnable (the idle signal for drivers)."""
+        if self._closed:
+            return False
+        self._form_banks()
+        bank = self._pick()
+        if bank is None:
+            return False
+        if bank.priority == INTERACTIVE and self.preempt != "off":
+            for other in self._banks:
+                if other is not bank and other.priority == BATCH:
+                    self._preempt(other)
+        self._advance(bank)
+        return True
+
+    def _advance(self, bank: _Bank) -> None:
+        try:
+            if not bank.started:
+                self._start(bank)
+            elif bank.preempted:
+                self._resume(bank)
+            bank.paused = False
+            with _telemetry.span("serve.window", bank=bank.seq,
+                                 window=bank.ex.window):
+                bank.ex.step()
+            _telemetry.inc("serve_windows_total")
+            self._charge(bank)
+            if bank.ex.done:
+                self._finalize(bank)
+        except _governor.MemoryAdmissionError as e:
+            # the bank does not fit next to the resident set: preempt a
+            # lower-priority resident bank to checkpoint and retry the
+            # start on a later step; with nothing left to evict the
+            # refusal is final
+            _telemetry.inc("serve_admission_stalls_total")
+            if not self._preempt_for_memory(bank):
+                self._fail(bank, e)
+        except QuESTError as e:
+            # structured refusal mid-flight (health, resume mismatch):
+            # fail the bank's jobs, keep serving the rest
+            self._fail(bank, e)
+
+    def _preempt_for_memory(self, needy: _Bank) -> bool:
+        """Free governed bytes for ``needy`` by checkpoint-preempting
+        one resident batch-class bank.  Returns False when nothing is
+        evictable (pause mode keeps state resident, so it cannot
+        help)."""
+        if self.preempt != "checkpoint":
+            return False
+        for other in self._banks:
+            if other is not needy and other.qureg is not None \
+                    and other.started and other.priority == BATCH \
+                    and other.running:
+                self._preempt(other)
+                return True
+        return False
+
+    def _finalize(self, bank: _Bank) -> None:
+        """Drain the finished bank: run the measurement schedule
+        (per-element key streams), hand each job its canonical
+        amplitudes + outcomes + final key state, and release the
+        register."""
+        q = bank.qureg
+        for qb in bank.measure:
+            outs, probs = _batch.measureBatched(q, qb)
+            for i, job in enumerate(bank.jobs):
+                job.outcomes.append((int(outs[i]), float(probs[i])))
+        amps = np.asarray(q.amps)
+        keys = q.key_state()
+        now = time.perf_counter()
+        for i, job in enumerate(bank.jobs):
+            job.amps = amps[i]
+            job.key_state = {"key": keys["keys"][i],
+                             "counter": keys["counters"][i]}
+            job.state = DONE
+            job.t_done = now
+            t = self.tenants[job.tenant]
+            t.inflight -= 1
+            t.inflight_bytes -= job.bytes
+            t.completed += 1
+            self.completed += 1
+            _telemetry.inc("serve_jobs_completed_total",
+                           tenant=job.tenant)
+            _telemetry.observe("serve_job_seconds", now - job.t_submit,
+                               tenant=job.tenant)
+        self._publish_occupancy(bank)
+        _governor.release(q)
+        bank.qureg = None
+        bank.ex = None
+        self._banks.remove(bank)
+        if bank.ckpt_dir and os.path.isdir(bank.ckpt_dir):
+            shutil.rmtree(bank.ckpt_dir, ignore_errors=True)
+
+    def _fail(self, bank: _Bank, err: BaseException) -> None:
+        now = time.perf_counter()
+        for job in bank.jobs:
+            job.state = FAILED
+            job.error = err
+            job.t_done = now
+            t = self.tenants[job.tenant]
+            if job.t_start is None:
+                self._queued -= 1
+            t.inflight -= 1
+            t.inflight_bytes -= job.bytes
+            _telemetry.inc("serve_jobs_failed_total", tenant=job.tenant)
+        if bank.qureg is not None:
+            _governor.release(bank.qureg)
+        bank.qureg = None
+        bank.ex = None
+        if bank in self._banks:
+            self._banks.remove(bank)
+        _telemetry.set_gauge("serve_queue_depth", self._queued)
+
+    # -- drivers ---------------------------------------------------------
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        """Step until nothing is runnable; returns the number of
+        windows executed.  ``max_steps`` bounds runaway loops in
+        tests."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    def stats(self) -> dict:
+        """Live queue/occupancy snapshot (the serving section of
+        reportPerf reads the telemetry counters; this is the
+        programmatic view)."""
+        waiting = sum(len(v) for v in self._buckets.values())
+        return {
+            "queued": self._queued,
+            "waiting_unbanked": waiting,
+            "banks": len(self._banks),
+            "preempted_banks": sum(1 for b in self._banks
+                                   if b.preempted or b.paused),
+            "completed": self.completed,
+            "tenants": {
+                name: {"weight": t.weight, "vtime": t.vtime,
+                       "inflight": t.inflight,
+                       "inflight_bytes": t.inflight_bytes,
+                       "submitted": t.submitted,
+                       "completed": t.completed}
+                for name, t in self.tenants.items()},
+        }
+
+    def close(self) -> None:
+        """Release live banks and (when the server created it) the
+        preemption checkpoint root."""
+        if self._closed:
+            return
+        self._closed = True
+        for bank in self._banks:
+            if bank.qureg is not None:
+                _governor.release(bank.qureg)
+            bank.qureg = None
+            bank.ex = None
+        self._banks.clear()
+        self._buckets.clear()
+        if self._own_ckpt_root:
+            shutil.rmtree(self._ckpt_root, ignore_errors=True)
+
+    def __enter__(self) -> "SimServer":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.close()
+
+
+class Service:
+    """Asyncio front end over a :class:`SimServer`: a cooperative
+    stepping loop plus awaitable submission.  Single event loop, no
+    threads — the scheduling core stays synchronous and deterministic,
+    the service yields to the loop between fusion windows (exactly the
+    safe points WindowExecutor guarantees).
+
+    Usage::
+
+        server = SimServer(env)
+        async with Service(server) as svc:
+            job = await svc.submit(gates, num_qubits=8,
+                                   tenant="alice",
+                                   priority="interactive")
+            amps = (await svc.wait(job)).amps
+    """
+
+    def __init__(self, server: SimServer, *, idle_sleep: float = 0.001):
+        self.server = server
+        self.idle_sleep = float(idle_sleep)
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    async def submit(self, gates, **kwargs) -> Job:
+        """Admit one job (QuotaExceededError propagates to the caller
+        — the await point IS the backpressure)."""
+        return self.server.submit(gates, **kwargs)
+
+    async def wait(self, job: Job) -> Job:
+        """Await a job's completion; re-raises its failure."""
+        while not job.done:
+            await asyncio.sleep(0)
+        if job.state == FAILED:
+            raise job.error
+        return job
+
+    async def submit_and_wait(self, gates, **kwargs) -> Job:
+        return await self.wait(await self.submit(gates, **kwargs))
+
+    async def _run(self) -> None:
+        while not self._stopping:
+            progressed = self.server.step()
+            # yield between windows so submissions/awaits interleave
+            # with execution — the continuous half of the batcher
+            await asyncio.sleep(0 if progressed else self.idle_sleep)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._stopping = False
+            self._task = asyncio.get_running_loop().create_task(
+                self._run())
+
+    async def aclose(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    async def __aenter__(self) -> "Service":
+        self.start()
+        return self
+
+    async def __aexit__(self, *_exc) -> None:
+        await self.aclose()

@@ -176,6 +176,11 @@ HIST_BOUNDS = {
     "fusion_window_gates": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
     "fusion_remap_window_items": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
                                   1024),
+    # serving-layer queue wait (serve.SimServer): interactive jobs on a
+    # loaded server should sit in the sub-ms..100ms decades, so the low
+    # end gets the same extra resolution as exchange latency
+    "serve_queue_wait_seconds": (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0,
+                                 60.0),
 }
 
 
@@ -523,6 +528,36 @@ def perf_report(env=None) -> str:
                      f"measured={_num(meas_b)}")
         verdict = ("MODEL DRIFT" if drift else "cost model holds")
         lines.append(f"  model_drift_total={_num(drift)} ({verdict})")
+    # serving layer (quest_tpu.serve): queue pressure, occupancy, and
+    # the preemption history — pure counter/gauge reads, so telemetry
+    # stays importable without the serve module
+    sub = counter_total("serve_jobs_submitted_total")
+    if sub:
+        done_n = counter_total("serve_jobs_completed_total")
+        rej = counter_total("serve_jobs_rejected_total")
+        failed = counter_total("serve_jobs_failed_total")
+        pre = counter_total("preemptions_total")
+        res = counter_total("serve_resumes_total")
+        depth = gauge_max("serve_queue_depth")
+        occ = gauge_max("serve_bank_occupancy")
+        lines.append("serving (continuous batcher):")
+        lines.append(
+            f"  jobs: submitted={_num(sub)} completed={_num(done_n)} "
+            f"rejected={_num(rej)} failed={_num(failed)}")
+        lines.append(
+            f"  preemptions={_num(pre)} resumes={_num(res)} "
+            f"queue_depth={_num(depth) if depth is not None else '-'} "
+            f"bank_occupancy="
+            f"{f'{occ:.3f}' if occ is not None else '-'}")
+        wait = snap["histograms"].get("serve_queue_wait_seconds", {})
+        tot_n = sum(hd["count"] for hd in wait.values())
+        tot_s = sum(hd["sum"] for hd in wait.values())
+        if tot_n:
+            wmax = max(hd["max"] for hd in wait.values()
+                       if hd["max"] is not None)
+            lines.append(
+                f"  queue_wait_seconds: count={tot_n} "
+                f"mean={tot_s / tot_n:.6g} max={wmax:.6g}")
     peak = gauge_max("hbm_watermark_bytes")
     if peak is not None:
         lines.append(f"memory: hbm_watermark_bytes peak={_num(peak)} "

@@ -1,0 +1,469 @@
+"""Multi-tenant serving layer (quest_tpu/serve.py).
+
+Covers the PR's contracts:
+
+- continuous batching: arrivals between fusion windows coalesce into a
+  bucket's next bank instead of waiting for a global drain, and the
+  served results are bit-identical to EnsembleScheduler.drain of the
+  same circuits;
+- admission control: structured QuotaExceededError on every limit
+  (global backpressure, per-tenant pending, per-tenant analytic bytes,
+  governor budget) — never unbounded queueing;
+- scheduling: strict interactive-before-batch classes and weighted
+  fair sharing between tenants within a class;
+- preempt-to-checkpoint: a long batch job preempted by an interactive
+  burst resumes BIT-IDENTICALLY to its uninterrupted run — amplitudes,
+  live permutation path (same fused windows), per-element measurement
+  key bank, and shot counters (the pinned test);
+- the EnsembleScheduler occupancy fix: the batch_occupancy gauge
+  aggregates real/padded over every bucket of a drain (padding
+  excluded) instead of being overwritten by the last bucket;
+- the async Service front end and the reportPerf serving section.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import batch as B
+from quest_tpu import circuit as C
+from quest_tpu import serve as S
+from quest_tpu import telemetry as T
+
+N = 4
+
+
+def _h(t):
+    m = np.array([[1.0, 1.0], [1.0, -1.0]]) / np.sqrt(2.0)
+    return C.Gate((t,), np.stack([m, np.zeros((2, 2))]))
+
+
+def _rz(t, theta):
+    d = np.exp(1j * np.array([-theta / 2, theta / 2]))
+    return C.Gate((t,), np.stack([np.diag(d.real), np.diag(d.imag)]))
+
+
+def _circ(theta, depth=3, n=N):
+    gates = []
+    for d in range(depth):
+        for q in range(n):
+            gates.append(_h(q))
+            gates.append(_rz(q, theta + 0.1 * q + d))
+    return gates
+
+
+@pytest.fixture
+def server(env):
+    srv = S.SimServer(env, window=4, max_batch=8)
+    yield srv
+    srv.close()
+
+
+class TestSubmitAndResults:
+    def test_results_match_ensemble_drain(self, env, server):
+        thetas = [0.3 + 0.05 * i for i in range(5)]
+        jobs = [server.submit(_circ(t), num_qubits=N, seed=i)
+                for i, t in enumerate(thetas)]
+        server.run_until_idle(max_steps=500)
+        sched = B.EnsembleScheduler(N, env, max_batch=8)
+        for t in thetas:
+            sched.submit(_circ(t))
+        expected = sched.drain()
+        for job, exp in zip(jobs, expected):
+            assert job.state == S.DONE
+            assert np.array_equal(np.asarray(job.amps), np.asarray(exp))
+
+    def test_result_before_completion_raises(self, server):
+        job = server.submit(_circ(0.1), num_qubits=N)
+        with pytest.raises(qt.QuESTError, match="before completion"):
+            job.result()
+        server.run_until_idle(max_steps=500)
+        assert job.result() is job.amps
+
+    def test_measurement_schedule_runs_per_element_streams(
+            self, env, server):
+        jobs = [server.submit(_circ(0.4), num_qubits=N, seed=7 + i,
+                              measure=(0, 2))
+                for i in range(3)]
+        server.run_until_idle(max_steps=500)
+        for job in jobs:
+            assert len(job.outcomes) == 2
+            assert all(o in (0, 1) for o, _p in job.outcomes)
+            assert job.key_state["counter"] == 2
+        # outcome streams are seed-keyed: a standalone bank seeded the
+        # way the server seeds its padded bank (pad repeats the last
+        # element) draws the exact same outcomes per element
+        q = B.createBatchedQureg(N, env, 4, seeds=[7, 8, 9, 9])
+        for g in _circ(0.4):
+            q._fusion.gates.append(g)
+        rounds = [B.measureBatched(q, t)[0] for t in (0, 2)]
+        for i, job in enumerate(jobs):
+            got = [o for o, _ in job.outcomes]
+            assert got == [int(rounds[0][i]), int(rounds[1][i])]
+
+    def test_mixed_structures_bucket_separately(self, server):
+        a = server.submit(_circ(0.2, depth=1), num_qubits=N)
+        b = server.submit(_circ(0.2, depth=2), num_qubits=N)
+        c = server.submit(_circ(0.9, depth=1), num_qubits=N)
+        server.run_until_idle(max_steps=500)
+        assert a.state == b.state == c.state == S.DONE
+        assert not np.array_equal(np.asarray(a.amps), np.asarray(b.amps))
+
+
+class TestContinuousBatching:
+    def test_arrival_mid_flight_coalesces_into_next_bank(self, server):
+        T.reset()
+        first = server.submit(_circ(0.1, depth=6), num_qubits=N)
+        server.step()  # starts bank 0, runs its first window
+        # arrivals while bank 0 is mid-flight: same fingerprint, so
+        # they coalesce into the bucket's NEXT bank — no global drain
+        late = [server.submit(_circ(0.1 + 0.01 * i, depth=6),
+                              num_qubits=N) for i in range(3)]
+        server.run_until_idle(max_steps=500)
+        assert first.state == S.DONE
+        assert all(j.state == S.DONE for j in late)
+        snap = T.snapshot()
+        # exactly two banks: the mid-flight one and one for all three
+        # late arrivals (batch-at-once would have run each separately)
+        assert snap["counters"]["serve_banks_total"][""] == 2
+
+    def test_open_bank_absorbs_arrivals_before_first_window(self, server):
+        T.reset()
+        for i in range(3):
+            server.submit(_circ(0.5), num_qubits=N, seed=i)
+        server.run_until_idle(max_steps=500)
+        snap = T.snapshot()
+        assert snap["counters"]["serve_banks_total"][""] == 1
+        # 3 real jobs in a padded-to-4 bank
+        assert snap["gauges"]["serve_bank_occupancy"][""] == 0.75
+
+    def test_per_tenant_bank_occupancy_gauge(self, server):
+        T.reset()
+        server.submit(_circ(0.5), num_qubits=N, tenant="a")
+        server.submit(_circ(0.6), num_qubits=N, tenant="a")
+        server.submit(_circ(0.7), num_qubits=N, tenant="b")
+        server.run_until_idle(max_steps=500)
+        snap = T.snapshot()
+        occ = snap["gauges"]["bank_occupancy"]
+        assert occ["tenant=a"] == 0.5   # 2 of the padded 4
+        assert occ["tenant=b"] == 0.25
+
+
+class TestAdmissionControl:
+    def test_global_backpressure(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8, max_pending=2)
+        try:
+            srv.submit(_circ(0.1), num_qubits=N)
+            srv.submit(_circ(0.2), num_qubits=N)
+            with pytest.raises(S.QuotaExceededError) as ei:
+                srv.submit(_circ(0.3), num_qubits=N)
+            assert ei.value.kind == "backpressure"
+            assert ei.value.limit == 2
+        finally:
+            srv.close()
+
+    def test_tenant_pending_quota(self, server):
+        server.register_tenant("small", max_pending=1)
+        server.submit(_circ(0.1), num_qubits=N, tenant="small")
+        with pytest.raises(S.QuotaExceededError) as ei:
+            server.submit(_circ(0.2), num_qubits=N, tenant="small")
+        assert ei.value.kind == "pending"
+        assert ei.value.tenant == "small"
+        # other tenants are unaffected
+        server.submit(_circ(0.2), num_qubits=N, tenant="other")
+        # completing the backlog frees the quota
+        server.run_until_idle(max_steps=500)
+        server.submit(_circ(0.3), num_qubits=N, tenant="small")
+
+    def test_tenant_byte_quota_analytic_pricing(self, env, server):
+        one_job = S._job_bytes_per_device(N, env, False)
+        server.register_tenant("capped", max_bytes=one_job)
+        server.submit(_circ(0.1), num_qubits=N, tenant="capped")
+        with pytest.raises(S.QuotaExceededError) as ei:
+            server.submit(_circ(0.2), num_qubits=N, tenant="capped")
+        assert ei.value.kind == "bytes"
+        assert ei.value.value == 2 * one_job
+
+    def test_rejections_are_counted(self, env):
+        T.reset()
+        srv = S.SimServer(env, window=4, max_batch=8, max_pending=1)
+        try:
+            srv.submit(_circ(0.1), num_qubits=N, tenant="t")
+            with pytest.raises(S.QuotaExceededError):
+                srv.submit(_circ(0.2), num_qubits=N, tenant="t")
+        finally:
+            srv.close()
+        assert T.counter_sum("serve_jobs_rejected_total",
+                             kind="backpressure") == 1
+
+
+class TestScheduling:
+    def test_interactive_runs_before_batch_backlog(self, server):
+        long_jobs = [server.submit(_circ(0.1 + i, depth=8), num_qubits=N)
+                     for i in range(2)]
+        vip = server.submit(_circ(0.9, depth=1), num_qubits=N,
+                            priority=S.INTERACTIVE, tenant="vip")
+        # the interactive job must complete within its own bank's
+        # window count — it never waits for the batch backlog
+        steps = 0
+        while not vip.done and steps < 50:
+            server.step()
+            steps += 1
+        assert vip.state == S.DONE
+        assert any(not j.done for j in long_jobs)
+        server.run_until_idle(max_steps=500)
+        assert all(j.state == S.DONE for j in long_jobs)
+
+    def test_weighted_fair_shares_windows(self, env):
+        srv = S.SimServer(env, window=2, max_batch=2)
+        try:
+            srv.register_tenant("heavy", weight=4.0)
+            srv.register_tenant("light", weight=1.0)
+            # same depth per job; distinct structures so the tenants
+            # never share a bank
+            for i in range(4):
+                srv.submit(_circ(0.1 * i, depth=4), num_qubits=N,
+                           tenant="heavy")
+                srv.submit(_circ(0.1 * i, depth=5), num_qubits=N,
+                           tenant="light")
+            srv.run_until_idle(max_steps=1000)
+            h = srv.tenants["heavy"]
+            li = srv.tenants["light"]
+            assert h.completed == li.completed == 4
+            # fair share: equal work means the heavier tenant ends at
+            # ~1/4 the virtual time of the lighter one
+            assert h.vtime < li.vtime
+        finally:
+            srv.close()
+
+    def test_vtime_catches_up_after_idle(self, server):
+        server.register_tenant("busy")
+        for i in range(3):
+            server.submit(_circ(0.2 * i, depth=4), num_qubits=N,
+                          tenant="busy")
+        server.run_until_idle(max_steps=500)
+        busy_vt = server.tenants["busy"].vtime
+        assert busy_vt > 0
+        # a newcomer does not get credit for the time it was absent
+        server.submit(_circ(0.7), num_qubits=N, tenant="newcomer")
+        assert server.tenants["newcomer"].vtime >= busy_vt
+
+
+class TestPreemption:
+    def _run_long_job(self, env, interrupt: bool, mode="checkpoint"):
+        """One long low-priority job, optionally interrupted by an
+        interactive burst after 3 windows; returns its results."""
+        srv = S.SimServer(env, window=4, max_batch=8, preempt=mode)
+        try:
+            job = srv.submit(_circ(0.5, depth=6), num_qubits=N,
+                             tenant="batchy", seed=11, measure=(0, 2))
+            for _ in range(3):
+                srv.step()
+            if interrupt:
+                burst = [srv.submit(_circ(1.5, depth=1), num_qubits=N,
+                                    tenant="vip", seed=40 + i,
+                                    priority=S.INTERACTIVE)
+                         for i in range(2)]
+                while not all(b.done for b in burst):
+                    srv.step()
+                assert all(b.state == S.DONE for b in burst)
+            srv.run_until_idle(max_steps=500)
+            assert job.state == S.DONE
+            return (np.asarray(job.amps).copy(), list(job.outcomes),
+                    dict(job.key_state))
+        finally:
+            srv.close()
+
+    def test_preempt_to_checkpoint_resume_bit_identical(self, env):
+        """THE pinned preemption contract: a long job preempted to a
+        checkpoint by an interactive burst and resumed is bit-identical
+        to the uninterrupted run — final amplitudes (via the same
+        window plan and live-perm path), measurement outcomes and
+        probabilities, the per-element RNG key bank, and the shot
+        counters."""
+        amps_a, out_a, key_a = self._run_long_job(env, interrupt=False)
+        T.reset()
+        amps_b, out_b, key_b = self._run_long_job(env, interrupt=True)
+        assert np.array_equal(amps_a, amps_b)
+        assert out_a == out_b
+        assert key_a == key_b          # key bank AND shot counter
+        snap = T.snapshot()
+        assert snap["counters"]["preemptions_total"][
+            "mode=checkpoint"] >= 1
+        assert snap["counters"]["serve_resumes_total"][""] >= 1
+
+    def test_pause_mode_is_also_bit_identical(self, env):
+        amps_a, out_a, key_a = self._run_long_job(
+            env, interrupt=False, mode="pause")
+        amps_b, out_b, key_b = self._run_long_job(
+            env, interrupt=True, mode="pause")
+        assert np.array_equal(amps_a, amps_b)
+        assert out_a == out_b and key_a == key_b
+
+    def test_preempt_off_disables_preemption(self, env):
+        T.reset()
+        srv = S.SimServer(env, window=4, max_batch=8, preempt="off")
+        try:
+            srv.submit(_circ(0.5, depth=6), num_qubits=N)
+            srv.step()
+            srv.submit(_circ(1.5, depth=1), num_qubits=N,
+                       priority=S.INTERACTIVE)
+            srv.run_until_idle(max_steps=500)
+        finally:
+            srv.close()
+        assert T.counter_total("preemptions_total") == 0
+
+
+class TestOccupancyAccounting:
+    def test_drain_gauge_aggregates_across_buckets(self, env):
+        """The satellite fix: two buckets (5/8 and 1/1) used to leave
+        whichever ran LAST in the batch_occupancy gauge; now the gauge
+        is the padding-excluded aggregate over the whole drain."""
+        T.reset()
+        sched = B.EnsembleScheduler(N, env, max_batch=8)
+        for i in range(5):
+            sched.submit(_circ(0.1 * i))       # one structure: 5/8
+        sched.submit(_circ(0.9, depth=1))      # another: 1/1
+        sched.drain()
+        snap = T.snapshot()
+        assert snap["gauges"]["batch_occupancy"][""] == \
+            pytest.approx(6 / 9)
+        # per-bucket histogram still records both buckets
+        hist = snap["histograms"]["ensemble_bucket_occupancy"][""]
+        assert hist["count"] == 2
+
+    def test_bank_occupancy_with_real_count(self):
+        class Fake:
+            batch_size = 8
+
+        occ = B.bank_occupancy(Fake(), real=5)
+        assert occ == {"size": 5, "bucket": 8, "occupancy": 5 / 8}
+
+
+class TestWindowExecutor:
+    def test_executor_matches_monolithic_drain(self, env):
+        gates = _circ(0.3, depth=5)
+        q1 = qt.createQureg(N, env)
+        q2 = qt.createQureg(N, env)
+        qt.startGateFusion(q1)
+        for g in gates:
+            q1._fusion.gates.append(g)
+        qt.stopGateFusion(q1)
+        from quest_tpu.resilience import WindowExecutor
+
+        ex = WindowExecutor(q2, gates, every=7)
+        windows = 0
+        while not ex.done:
+            ex.step()
+            windows += 1
+        assert windows == ex.num_windows
+        assert ex.cursor == len(gates)
+        assert np.array_equal(np.asarray(q1.amps), np.asarray(q2.amps))
+
+    def test_checkpoint_resume_midstream(self, env, tmp_path):
+        from quest_tpu import resilience as R
+
+        gates = _circ(0.3, depth=5)
+        q1 = qt.createQureg(N, env)
+        ex = R.WindowExecutor(q1, gates, every=7, fingerprint="fp-t")
+        ex.step()
+        ex.step()
+        ex.checkpoint(str(tmp_path))
+        cursor = ex.cursor
+        # fresh register resumes from the generation
+        q2, meta = R.load_latest(str(tmp_path), env)
+        assert int(meta["cursor"]) == cursor
+        ex2 = R.WindowExecutor(q2, gates, every=7, start=cursor)
+        while not ex2.done:
+            ex2.step()
+        while not ex.done:
+            ex.step()
+        assert np.array_equal(np.asarray(q1.amps), np.asarray(q2.amps))
+
+
+class TestAsyncService:
+    def test_async_submit_and_wait(self, env):
+        async def main():
+            srv = S.SimServer(env, window=4, max_batch=8)
+            try:
+                async with S.Service(srv, idle_sleep=0.0005) as svc:
+                    jobs = [await svc.submit(
+                        _circ(0.2 + 0.1 * i), num_qubits=N, seed=i)
+                        for i in range(3)]
+                    done = [await svc.wait(j) for j in jobs]
+                    return [j.state for j in done]
+            finally:
+                srv.close()
+
+        states = asyncio.run(main())
+        assert states == [S.DONE] * 3
+
+    def test_async_quota_error_propagates(self, env):
+        async def main():
+            srv = S.SimServer(env, window=4, max_batch=8, max_pending=1)
+            try:
+                async with S.Service(srv) as svc:
+                    await svc.submit(_circ(0.1), num_qubits=N)
+                    with pytest.raises(S.QuotaExceededError):
+                        await svc.submit(_circ(0.2), num_qubits=N)
+            finally:
+                srv.close()
+
+        asyncio.run(main())
+
+
+class TestReportPerf:
+    def test_serving_section_in_perf_report(self, env, server):
+        T.reset()
+        server.submit(_circ(0.3), num_qubits=N, tenant="acme")
+        server.run_until_idle(max_steps=500)
+        report = T.perf_report()
+        assert "serving (continuous batcher):" in report
+        assert "jobs: submitted=1 completed=1" in report
+        assert "queue_wait_seconds:" in report
+
+    def test_stats_snapshot(self, server):
+        server.submit(_circ(0.3), num_qubits=N, tenant="acme")
+        st = server.stats()
+        assert st["queued"] == 1
+        assert st["tenants"]["acme"]["inflight"] == 1
+        server.run_until_idle(max_steps=500)
+        st = server.stats()
+        assert st["completed"] == 1
+        assert st["tenants"]["acme"]["inflight"] == 0
+
+
+class TestServerLifecycle:
+    def test_submit_after_close_raises(self, env):
+        srv = S.SimServer(env)
+        srv.close()
+        with pytest.raises(qt.QuESTError, match="close"):
+            srv.submit(_circ(0.1), num_qubits=N)
+
+    def test_config_validation(self, env):
+        with pytest.raises(qt.QuESTError, match="power of two"):
+            S.SimServer(env, max_batch=3)
+        with pytest.raises(qt.QuESTError, match="window"):
+            S.SimServer(env, window=0)
+        with pytest.raises(qt.QuESTError, match="preempt"):
+            S.SimServer(env, preempt="sometimes")
+
+    def test_env_knobs(self, env, monkeypatch):
+        monkeypatch.setenv("QT_SERVE_WINDOW", "9")
+        monkeypatch.setenv("QT_SERVE_MAX_BATCH", "32")
+        monkeypatch.setenv("QT_SERVE_PREEMPT", "pause")
+        srv = S.SimServer(env)
+        try:
+            assert srv.window == 9
+            assert srv.max_batch == 32
+            assert srv.preempt == "pause"
+        finally:
+            srv.close()
+
+    def test_exports(self):
+        assert qt.SimServer is S.SimServer
+        assert qt.SimService is S.Service
+        assert qt.QuotaExceededError is S.QuotaExceededError
+        assert qt.WindowExecutor is not None
