@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-regress bench docs clean
 
 all: native
 
@@ -92,6 +92,16 @@ verify-governor:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_governor.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -k "Oom or oom" -p no:cacheprovider -p no:xdist -p no:randomly
 	python scripts/bench_governor.py
+
+# Pod-scale topology layer (docs/design.md §25): the hierarchical
+# DCN x ICI model, tier-classified exchange accounting, HLO placement
+# pins, host-loss failover — plus the planner A/B guard (tier-aware
+# remap must cut modeled AND measured DCN bytes >= 2x vs flat planning
+# on the emulated slow-DCN 2x4 churn workload, bit-identically).  The
+# reduction joins the regression trajectory as bench_suite config 13.
+verify-pod:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_topology.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_pod.py
 
 # Regression gate over the committed BENCH_r*.json trajectory: every
 # normalized metric must stay within 15% of its drift-resistant median

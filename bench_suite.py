@@ -661,9 +661,41 @@ def config12():
            "arrival_rate_per_sec": rec["arrival_rate_per_sec"]})
 
 
+def config13():
+    """Pod-topology tier-aware planner A/B (ISSUE 12): the config-6
+    style churn workload drained on the emulated slow-DCN 2x4 topology
+    under the flat vs the hierarchical remap planner
+    (scripts/bench_pod.py).  The timing line carries the measured DCN
+    byte reduction (the headline — must be >= 2x, gated separately by
+    make verify-pod) plus the modeled reduction, the weighted-cost
+    ratio, and the bit-identity/drift checks."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_pod
+
+    t0 = time.perf_counter()
+    try:
+        rec = bench_pod.run(n=10 if CPU else 24, reps=10)
+    except RuntimeError as e:
+        _emit(13, f"2x4 tier-aware DCN reduction (SKIPPED: {e})",
+              0.0, "dcn_reduction_x", 0.0)
+        return
+    _set_compile(0.0)  # both arms warm inside run()
+    _emit(13, f"{rec['n']}q 2x4 tier-aware DCN byte reduction",
+          rec["measured_dcn_reduction"], "dcn_reduction_x",
+          round(time.perf_counter() - t0, 3),
+          {"modeled_dcn_reduction": rec["modeled_dcn_reduction"],
+           "weighted_cost_reduction": rec["weighted_cost_reduction"],
+           "flat_dcn_bytes": rec["flat"]["measured"].get("dcn", 0),
+           "hier_dcn_bytes": rec["hier"]["measured"].get("dcn", 0),
+           "bit_identical": rec["bit_identical"],
+           "model_drift": rec["flat"]["drift"] + rec["hier"]["drift"],
+           "topology": rec["topology"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
+           11: config11, 12: config12, 13: config13}
 
 
 def main():

@@ -13,6 +13,11 @@ fails when:
   into their base opcode) differs from the declaration;
 * the bytes moved by the largest collective's operands exceed
   ``max_exchange_bytes`` (parsed from the HLO output shapes);
+* a contract declares per-tier caps (``max_tier_bytes``) and the
+  largest payload riding either interconnect tier exceeds its cap —
+  the 8-shard mesh is read as a forced 2x4 hosts x chips arrangement
+  and each collective-permute's compiled ``source_target_pairs`` table
+  is classified as ICI (within a host) or DCN (crossing hosts);
 * a required wrapper is missing a contract, or a contract names a
   wrapper that no longer exists.
 
@@ -33,6 +38,14 @@ _ELEM_BYTES = {"8": 1, "16": 2, "32": 4, "64": 8, "128": 16}
 
 CANONICAL_N = 10          # state bits of the canonical dispatch
 CANONICAL_SHARDS = 8      # r = 3 mesh bits
+
+# forced hosts x chips arrangement of the canonical mesh for the
+# per-tier byte caps (ShardedContract.max_tier_bytes): the 8 shards are
+# read as 2 hosts x 4 chips, so mesh bits 0-1 are ICI and bit 2 is DCN —
+# purely a CLASSIFICATION of the compiled routing tables
+# (source_target_pairs), no env var or recompilation involved
+VERIFY_HOSTS = 2
+VERIFY_CHIPS = 4
 
 
 def _shape_bytes(segment: str) -> int:
@@ -72,6 +85,42 @@ def _measured_exchange_bytes(hlo_text: str, families) -> int:
         if any(f" {fam}(" in line or f" {fam}-start(" in line
                for fam in families):
             best = max(best, _shape_bytes(line))
+    return best
+
+
+def _measured_tier_bytes(hlo_text: str, families,
+                         chips: int) -> Dict[str, int]:
+    """Max payload bytes per interconnect tier over the contract's
+    collective instructions, reading the canonical mesh as
+    ``hosts x chips``.
+
+    Each instruction's compiled ``source_target_pairs`` routing table is
+    classified arithmetically: a pair crosses DCN iff the shard ids
+    disagree above the chip bits (``src ^ dst >= chips``); an
+    instruction rides DCN when any of its pairs cross.  Collectives
+    without a routing table (all-gather and friends) span the whole
+    mesh and count toward both tiers.
+    """
+    from quest_tpu.introspect import _PAIR_RE, _PAIRS_RE
+    from quest_tpu.parallel import topology
+
+    best = {"ici": 0, "dcn": 0}
+    for line in hlo_text.splitlines():
+        if not any(f" {fam}(" in line or f" {fam}-start(" in line
+                   for fam in families):
+            continue
+        nbytes = _shape_bytes(line)
+        m = _PAIRS_RE.search(line)
+        if m is None:
+            for tier in best:
+                best[tier] = max(best[tier], nbytes)
+            continue
+        pairs = [(int(a), int(b)) for a, b in _PAIR_RE.findall(m.group(1))]
+        split = topology.split_pair_list(pairs, chips)
+        if not (split["ici"] or split["dcn"]):
+            continue  # self-pairs only: no wire traffic
+        tier = "dcn" if split["dcn"] else "ici"
+        best[tier] = max(best[tier], nbytes)
     return best
 
 
@@ -197,6 +246,20 @@ def verify_sharded_contracts(env=None, contracts=None) -> List[str]:
                 f"{name}: largest collective payload is {got_bytes} B, "
                 f"over the declared max_exchange_bytes="
                 f"{decl.max_exchange_bytes}")
+            continue
+        if decl.max_tier_bytes:
+            tiers = _measured_tier_bytes(report.text,
+                                         decl.collectives.keys(),
+                                         VERIFY_CHIPS)
+            for tier in sorted(decl.max_tier_bytes):
+                cap = decl.max_tier_bytes[tier]
+                got = tiers.get(tier, 0)
+                if got > cap:
+                    errors.append(
+                        f"{name}: {tier} collective payload is {got} B, "
+                        f"over the declared max_tier_bytes[{tier}]="
+                        f"{cap} (mesh read as {VERIFY_HOSTS}x"
+                        f"{VERIFY_CHIPS} hosts x chips)")
     return errors
 
 
@@ -213,6 +276,8 @@ def main() -> int:
         return 1
     from quest_tpu.contracts import SHARDED_CONTRACTS
     for name, c in sorted(SHARDED_CONTRACTS.items()):
+        tiers = (f" tiers<={dict(sorted(c.max_tier_bytes.items()))}"
+                 if c.max_tier_bytes else "")
         print(f"qlint contracts: ok {name} {dict(c.collectives)} "
-              f"<= {c.max_exchange_bytes} B")
+              f"<= {c.max_exchange_bytes} B{tiers}")
     return 0

@@ -52,7 +52,7 @@ import itertools
 import math
 from dataclasses import dataclass
 from functools import lru_cache
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1292,6 +1292,21 @@ def remap_exchange_bytes(sigma: Tuple[int, ...], num_qubits: int, nloc: int,
     if mesh_tau is not None:
         total += shard
     return total
+
+
+def remap_exchange_bytes_tiers(sigma: Tuple[int, ...], num_qubits: int,
+                               nloc: int, itemsize: int = 8,
+                               topology=None) -> Dict[str, int]:
+    """Per-interconnect-tier split of :func:`remap_exchange_bytes` —
+    ``{"ici": bytes, "dcn": bytes}`` summing exactly to the flat total
+    (dist.remap_exchange_tiers on the byte axis).  Feeds the per-tier
+    columns of introspect.explain_circuit, the governor's weighted drain
+    cost and scripts/bench_pod.py's modeled A/B gate."""
+    from .parallel import dist as PAR
+
+    r = num_qubits - nloc
+    tiers = PAR.remap_exchange_tiers(sigma, nloc, r, itemsize, topology)
+    return {tier: b for tier, (_c, b) in tiers.items()}
 
 
 def plan_remap_windows(bit_sets: Sequence[Tuple[int, ...]], num_qubits: int,

@@ -42,10 +42,20 @@ class ShardedContract:
     name: str
     collectives: Dict[str, int]
     max_exchange_bytes: int
+    # Optional per-interconnect-tier byte caps ({"ici": .., "dcn": ..})
+    # for the canonical dispatch on the forced hierarchical dryrun
+    # topology (parallel/topology.py; hlocheck verifies on a 2x4
+    # arrangement of the 8-shard mesh).  None = tier-agnostic: only the
+    # flat max_exchange_bytes cap applies.
+    max_tier_bytes: Optional[Dict[str, int]] = None
 
     def as_dict(self) -> dict:
-        return {"name": self.name, "collectives": dict(self.collectives),
-                "max_exchange_bytes": int(self.max_exchange_bytes)}
+        out = {"name": self.name, "collectives": dict(self.collectives),
+               "max_exchange_bytes": int(self.max_exchange_bytes)}
+        if self.max_tier_bytes is not None:
+            out["max_tier_bytes"] = {
+                k: int(v) for k, v in self.max_tier_bytes.items()}
+        return out
 
 
 # name -> ShardedContract for every decorated wrapper, in decoration
@@ -68,20 +78,26 @@ REQUIRED_WRAPPERS = (
 
 def sharded_contract(*, collectives: Dict[str, int],
                      max_exchange_bytes: int,
+                     max_tier_bytes: Optional[Dict[str, int]] = None,
                      name: Optional[str] = None) -> Callable:
     """Declare a sharded dispatch wrapper's collective contract.
 
     Registers the declaration in :data:`SHARDED_CONTRACTS` and attaches
     it to the function as ``__sharded_contract__``.  Purely declarative —
     zero dispatch-time overhead; enforcement happens offline against the
-    compiled HLO (analysis/hlocheck.py)."""
+    compiled HLO (analysis/hlocheck.py).  ``max_tier_bytes`` optionally
+    caps the per-shard bytes crossing each interconnect tier on the
+    hierarchical verification dryrun (see ShardedContract)."""
     decl_collectives = {str(k): int(v) for k, v in collectives.items()}
+    decl_tier = (None if max_tier_bytes is None
+                 else {str(k): int(v) for k, v in max_tier_bytes.items()})
 
     def deco(fn: Callable) -> Callable:
         contract = ShardedContract(
             name=name or fn.__name__,
             collectives=decl_collectives,
             max_exchange_bytes=int(max_exchange_bytes),
+            max_tier_bytes=decl_tier,
         )
         SHARDED_CONTRACTS[contract.name] = contract
         fn.__sharded_contract__ = contract

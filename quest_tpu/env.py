@@ -61,6 +61,13 @@ class QuESTEnv:
     rank: int
     num_ranks: int
     seeds: tuple
+    # hierarchical hosts x chips arrangement of the amplitude mesh
+    # (parallel/topology.py; resolved from QT_TOPOLOGY at creation and
+    # carried through shrink_env so a failed-over env keeps classifying
+    # its surviving interconnect correctly even while the env var still
+    # describes the old shape).  None only on hand-built envs; accessors
+    # fall back to the flat single-host arrangement.
+    topology: Optional[object] = None
 
     @property
     def num_devices(self) -> int:
@@ -216,28 +223,42 @@ def create_quest_env(
     pow2 = 1 << (n.bit_length() - 1)
     devices = devices[:pow2]
     mesh = Mesh(np.array(devices), (AMP_AXIS,))
+    from .parallel import topology as _topo
+
     env = QuESTEnv(
         mesh=mesh,
         rank=jax.process_index(),
         num_ranks=pow2,
         seeds=(),
+        topology=_topo.resolve(pow2),
     )
     seed_quest_default(env)
     return env
 
 
 def shrink_env(env: QuESTEnv, num_devices: int, *,
-               exclude_index: Optional[int] = None) -> QuESTEnv:
+               exclude_index: Optional[int] = None,
+               exclude_indices: Optional[Sequence[int]] = None) -> QuESTEnv:
     """A degraded environment over a power-of-two subset of ``env``'s
     devices — the mesh half of the elastic failover path
     (resilience._failover) and of loadQureg's auto-reshard.
 
     ``exclude_index`` drops one device (the presumed-dead shard) before
-    truncating; the result keeps ``env``'s seeds WITHOUT reseeding — the
-    RNG streams belong to the run, not the mesh, and a failover restores
-    them from the checkpoint anyway."""
+    truncating; ``exclude_indices`` drops a set — the host-loss path
+    excludes the dead host's whole device range
+    (topology.host_range) so the surviving mesh is built from intact
+    hosts only.  The result keeps ``env``'s seeds WITHOUT reseeding —
+    the RNG streams belong to the run, not the mesh, and a failover
+    restores them from the checkpoint anyway.  The degraded topology is
+    derived with topology.shrink: a whole-host loss keeps the
+    chips-per-host arrangement (2x4 -> 1x4), a sub-host shrink
+    collapses to single-host."""
+    dead = set() if exclude_indices is None else {
+        int(i) for i in exclude_indices}
+    if exclude_index is not None:
+        dead.add(int(exclude_index))
     devs = [d for i, d in enumerate(env.mesh.devices.reshape(-1).tolist())
-            if i != exclude_index]
+            if i not in dead]
     num_devices = int(num_devices)
     if num_devices < 1 or num_devices & (num_devices - 1):
         raise ValueError(
@@ -248,8 +269,11 @@ def shrink_env(env: QuESTEnv, num_devices: int, *,
             f"shrink_env: asked for {num_devices} devices but only "
             f"{len(devs)} survive in this environment")
     mesh = Mesh(np.array(devs[:num_devices]), (AMP_AXIS,))
+    from .parallel import topology as _topo
+
     return QuESTEnv(mesh=mesh, rank=env.rank, num_ranks=num_devices,
-                    seeds=env.seeds)
+                    seeds=env.seeds,
+                    topology=_topo.shrink(env.topology, num_devices))
 
 
 def destroy_quest_env(env: QuESTEnv) -> None:
@@ -286,7 +310,11 @@ def get_environment_string(env: QuESTEnv) -> str:
     )
     from . import resilience
     from .parallel import dist
+    from .parallel import topology as _topo
 
+    t = env.topology if env.topology is not None \
+        else _topo.resolve(env.num_devices)
+    s += f" Topology={t.describe()}"
     s += f" ExchangeChunks={dist.exchange_config_key() or 'auto'}"
     # reproducibility surface: when the measurement RNG is still on its
     # time+pid default seed, report the chosen keys so the run can be

@@ -120,7 +120,7 @@ class ChannelItem:
         self.prob = float(prob)
 
 
-def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None):
+def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None, nsh: int = 0):
     """Content key for a fully-concrete item list, or None when any matrix
     is traced/non-numpy.  Matrices in a drain are small (2x2..128x128), so
     hashing their bytes is negligible next to planning them (~0.2 s of
@@ -128,7 +128,10 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None):
     (kind, target) only — the probability is a runtime argument.  On a
     sharded register the key also carries the live logical->physical
     permutation the drain starts from — the same items plan to different
-    windows/remaps under a different starting perm."""
+    windows/remaps under a different starting perm — and the topology
+    signature (parallel/topology.py): the tier-aware window planner
+    parks evictees differently per arrangement, so a QT_TOPOLOGY /
+    planner-mode flip must not reuse a stale plan."""
     parts = []
     for it in items:
         if isinstance(it, ChannelItem):
@@ -138,7 +141,13 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None):
         if not isinstance(m, np.ndarray):
             return None
         parts.append((it.targets, m.dtype.str, m.shape, m.tobytes()))
-    return (nloc, sweep_ok, perm0, tuple(parts))
+    if nsh:
+        from .parallel import topology as _topo
+
+        topo_sig = _topo.signature(1 << nsh)
+    else:
+        topo_sig = None
+    return (nloc, sweep_ok, perm0, topo_sig, tuple(parts))
 
 
 def _split_items(items, nloc: int, sweep_ok: bool):
@@ -280,7 +289,7 @@ def _run(qureg, items) -> None:
     from .ops import fused as _fusedmod
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
     perm0 = qureg._perm if nsh else None
-    key = _plan_key(items, nloc, sweep_ok, perm0)
+    key = _plan_key(items, nloc, sweep_ok, perm0, nsh)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
         _telemetry.inc("fusion_plan_cache_hits_total")
@@ -334,23 +343,31 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
             # (circuit.remap_exchange_bytes / dist.decompose_sigma)
             from .parallel import dist as PAR
 
+            from .parallel import topology as _topo
+
             itemsize = np.dtype(qureg.dtype).itemsize
             ck = str(PAR.exchange_config_key() or "auto")
+            topology = _topo.resolve(1 << nsh)
             meas_c0 = _telemetry.counter_sum("exchanges_total",
                                              op="window_remap")
             meas_b0 = _telemetry.counter_sum("exchange_bytes_total",
                                              op="window_remap")
+            meas_t0 = {t: _telemetry.counter_sum(
+                "exchange_bytes_total", op="window_remap", tier=t)
+                for t in _topo.TIERS}
             for part in program:
                 if part[0] != "remap":
                     continue
                 sigma = part[1]
-                cnt = PAR.remap_exchange_count(sigma, nloc, nsh)
-                if cnt:
-                    _telemetry.record_exchange(
-                        "window_remap", cnt * bw,
-                        bw * C.remap_exchange_bytes(sigma, n, nloc,
-                                                    itemsize),
-                        chunks=ck)
+                # per-tier exchange classes straight from the same cost
+                # model the tests pin (dist.remap_exchange_tiers sums
+                # exactly to remap_exchange_count/remap_exchange_bytes)
+                for tier, (cnt, b) in PAR.remap_exchange_tiers(
+                        sigma, nloc, nsh, itemsize, topology).items():
+                    if cnt or b:
+                        _telemetry.record_exchange(
+                            "window_remap", cnt * bw, b * bw,
+                            chunks=ck, tier=tier)
             # reconcile the drain's measured window-remap deltas against
             # an independent re-plan through the cost model — any
             # disagreement is model drift (introspect, docs/design.md §21)
@@ -364,7 +381,10 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
                     "exchanges_total", op="window_remap") - meas_c0,
                 measured_bytes=_telemetry.counter_sum(
                     "exchange_bytes_total", op="window_remap") - meas_b0,
-                measured_chunks=ck)
+                measured_chunks=ck,
+                measured_tier_bytes={t: _telemetry.counter_sum(
+                    "exchange_bytes_total", op="window_remap", tier=t)
+                    - meas_t0[t] for t in _topo.TIERS})
     probs = tuple(it.prob for it in items if isinstance(it, ChannelItem))
     from .ops import fused as _fused
     if nsh:
@@ -480,7 +500,7 @@ def plan_items_quiet(qureg, items):
     perm0 = qureg._perm if nsh else None
     if not items:
         return (), (), None, nloc, nsh
-    key = _plan_key(items, nloc, sweep_ok, perm0)
+    key = _plan_key(items, nloc, sweep_ok, perm0, nsh)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
         program, arrays, final_perm = hit

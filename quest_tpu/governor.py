@@ -454,6 +454,25 @@ def predict_drain(qureg, program, arrays, *, nloc: int, nsh: int,
             else state)
     other = resident_bytes(exclude=qureg)
     b = budget_bytes()
+    # per-interconnect-tier exchange bytes of the drain's remap parts —
+    # the hierarchical (QT_TOPOLOGY) refinement of the exchange volume,
+    # weighted by the relative link cost so the drain-peak report also
+    # says how much of its traffic rides the slow DCN tier
+    tier_b = {"ici": 0, "dcn": 0}
+    if nsh:
+        from .parallel import dist as PAR
+        from .parallel import topology as _topo
+
+        topology = _topo.resolve(1 << nsh)
+        for part in program:
+            if part[0] != "remap":
+                continue
+            for t, (_cnt, nb) in PAR.remap_exchange_tiers(
+                    part[1], nloc, nsh, itemsize, topology).items():
+                tier_b[t] += nb
+        weights = _topo.tier_weights()
+    else:
+        weights = {"ici": 1.0, "dcn": 1.0}
     return {
         "policy": policy(),
         "budget_bytes": b,
@@ -468,6 +487,9 @@ def predict_drain(qureg, program, arrays, *, nloc: int, nsh: int,
         "headroom_bytes": (None if b is None
                            else int(b - other - peak)),
         "fits": (None if b is None else bool(other + peak <= b)),
+        "exchange_tier_bytes": {t: int(v) for t, v in tier_b.items()},
+        "weighted_exchange_cost": float(sum(
+            weights[t] * v for t, v in tier_b.items())),
     }
 
 

@@ -124,6 +124,9 @@ def _sigma_cost(sigma, n: int, nloc: int, nsh: int, itemsize: int,
 
     mixed, _lp, mesh_tau = PAR.decompose_sigma(tuple(sigma), nloc, nsh)
     ch_half, ch_full = PAR.remap_chunk_plan(nloc, itemsize, backend=backend)
+    # per-interconnect-tier refinement of the same model (QT_TOPOLOGY;
+    # single-host arrangements put everything under "ici")
+    tiers = PAR.remap_exchange_tiers(tuple(sigma), nloc, nsh, itemsize)
     return {
         "sigma": [int(p) for p in sigma],
         "mixed_swaps": len(mixed),
@@ -131,6 +134,8 @@ def _sigma_cost(sigma, n: int, nloc: int, nsh: int, itemsize: int,
         "exchanges": PAR.remap_exchange_count(tuple(sigma), nloc, nsh),
         "exchange_bytes": int(C.remap_exchange_bytes(
             tuple(sigma), n, nloc, itemsize)),
+        "tier_bytes": {t: int(b) for t, (_c, b) in tiers.items()},
+        "tier_exchanges": {t: int(c) for t, (c, _b) in tiers.items()},
         "chunks": {"half_shard": int(ch_half), "full_shard": int(ch_full)},
     }
 
@@ -150,6 +155,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     when the plan leaves a live permutation behind."""
     from . import fusion as F
     from .ops import fused as _fusedmod
+    from .parallel import topology as _topology
 
     if gates is None:
         buf = getattr(qureg, "_fusion", None)
@@ -184,6 +190,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     final_remap = None
     tot_exch = 0
     tot_bytes = 0
+    tot_tier = {"ici": 0, "dcn": 0}
     plan_windows = 0
     if nsh and items:
         segments, final_perm = C.plan_remap_windows(
@@ -199,6 +206,10 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                 entry.update(_sigma_cost(sigma, n, nloc, nsh, itemsize))
                 entry["exchanges"] *= bw
                 entry["exchange_bytes"] *= bw
+                for t in entry["tier_bytes"]:
+                    entry["tier_bytes"][t] *= bw
+                    entry["tier_exchanges"][t] *= bw
+                    tot_tier[t] += entry["tier_bytes"][t]
                 tot_exch += entry["exchanges"]
                 tot_bytes += entry["exchange_bytes"]
             windows.append(entry)
@@ -210,6 +221,9 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                 itemsize)
             final_remap["exchanges"] *= bw
             final_remap["exchange_bytes"] *= bw
+            for t in final_remap["tier_bytes"]:
+                final_remap["tier_bytes"][t] *= bw
+                final_remap["tier_exchanges"][t] *= bw
             final_remap["final_perm"] = [int(p) for p in final_perm]
     else:
         parts, ngates, nchans = _segment_stats(items)
@@ -221,7 +235,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                             "exchanges": 0, "exchange_bytes": 0,
                             "chunks": None})
 
-    key = F._plan_key(items, nloc, sweep_ok, perm0) if items else None
+    key = F._plan_key(items, nloc, sweep_ok, perm0, nsh) if items else None
     cacheable = key is not None
     hit = cacheable and key in F._plan_cache
     from .parallel import dist as PAR
@@ -259,6 +273,12 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
             "exchange_bytes": int(tot_bytes),
             "exchanges_with_read": int(tot_exch + read_exch),
             "exchange_bytes_with_read": int(tot_bytes + read_bytes),
+            "tier_bytes": {t: int(b) for t, b in tot_tier.items()},
+            "weighted_exchange_cost": float(sum(
+                _topology.tier_weights()[t] * b
+                for t, b in tot_tier.items())),
+            "topology": _topology.resolve(1 << nsh).describe()
+            if nsh else None,
         },
     )
 
@@ -306,6 +326,11 @@ def format_explain(report: dict) -> str:
         + (f" (+{t['exchanges_with_read'] - t['exchanges']} exch / "
            f"+{t['exchange_bytes_with_read'] - t['exchange_bytes']} bytes "
            f"at read)" if report["final_remap"] else ""))
+    if t.get("topology"):
+        tb = t["tier_bytes"]
+        lines.append(
+            f"topology: {t['topology']} tier bytes: ici={tb['ici']} "
+            f"dcn={tb['dcn']} weighted_cost={t['weighted_exchange_cost']:.0f}")
     mem = report.get("memory")
     if mem:
         line = (f"memory: peak/device={mem['predicted_peak_bytes']} "
@@ -345,6 +370,11 @@ COLLECTIVE_OPS = (
     "all-to-all", "reduce-scatter",
 )
 
+# one collective-permute instruction's routing table in optimized HLO:
+# source_target_pairs={{0,1},{1,0},...}
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:[^{}]|\{[^{}]*\})*)\}")
+_PAIR_RE = re.compile(r"\{\s*(\d+)\s*,\s*(\d+)\s*\}")
+
 
 class CollectiveBudgetError(AssertionError):
     """An audited program exceeded its collective budget."""
@@ -377,6 +407,25 @@ class AuditReport:
     @property
     def total(self) -> int:
         return sum(self.collectives.values())
+
+    def tier_counts(self, chips: int) -> dict:
+        """Per-interconnect-tier histogram of the compiled program's
+        collective-permute instructions under an ``hosts x chips``
+        arrangement (parallel/topology.py): an instruction whose routing
+        table contains ANY pair crossing a host boundary
+        (``src ^ dst >= chips``) counts as "dcn", else "ici" — the
+        emulated-topology placement pin hlocheck's per-tier verification
+        and tests/test_topology.py assert against real HLO."""
+        from .parallel import topology as _topo
+
+        out = {"ici": 0, "dcn": 0}
+        for m in _PAIRS_RE.finditer(self.text):
+            pairs = [(int(a), int(b))
+                     for a, b in _PAIR_RE.findall(m.group(1))]
+            split = _topo.split_pair_list(pairs, chips)
+            if split["ici"] or split["dcn"]:
+                out["dcn" if split["dcn"] else "ici"] += 1
+        return out
 
     def as_dict(self) -> dict:
         return {"collectives": dict(self.collectives),
@@ -563,14 +612,21 @@ def _apply_perturbations(pred: dict) -> dict:
 
 @functools.lru_cache(maxsize=256)
 def _predict_cached(bit_key, n: int, nloc: int, nsh: int, perm_key,
-                    itemsize: int):
+                    itemsize: int, topo_sig):
     # Pure function of the plan inputs, memoized so the per-drain
     # reconciliation stays O(1) on repeated streams — the measured path
     # it is compared against hits the plan cache the same way.
+    # ``topo_sig`` (topology.signature) keys the memo on the live
+    # QT_TOPOLOGY / planner-mode arrangement: the tier-aware planner
+    # emits different sigmas per arrangement, so a stale entry would
+    # mispredict across an env flip.
     from .parallel import dist as PAR
+    from .parallel import topology as _topo
 
     count = 0
     nbytes = 0
+    tiers = {"ici": 0, "dcn": 0}
+    topology = _topo.resolve(1 << nsh)
     segments, _final_perm = C.plan_remap_windows(
         [list(b) for b in bit_key], n, nloc,
         list(perm_key) if perm_key is not None else None)
@@ -579,7 +635,10 @@ def _predict_cached(bit_key, n: int, nloc: int, nsh: int, perm_key,
             continue
         count += PAR.remap_exchange_count(tuple(sigma), nloc, nsh)
         nbytes += C.remap_exchange_bytes(tuple(sigma), n, nloc, itemsize)
-    return count, nbytes
+        for t, (_c, b) in PAR.remap_exchange_tiers(
+                tuple(sigma), nloc, nsh, itemsize, topology).items():
+            tiers[t] += b
+    return count, nbytes, (tiers["ici"], tiers["dcn"])
 
 
 def predict_window_exchanges(bit_sets: Sequence, n: int, nloc: int,
@@ -588,30 +647,38 @@ def predict_window_exchanges(bit_sets: Sequence, n: int, nloc: int,
     """Independent re-derivation of what a sharded drain over
     ``bit_sets`` must exchange (``op=window_remap`` only — the
     canonical-read rematerialization is the separate ``op=remap``):
-    re-plan the windows and fold every sigma through the cost model.
-    This is the prediction reconcile_drain holds the measured counters
-    against."""
+    re-plan the windows and fold every sigma through the cost model,
+    including the per-interconnect-tier byte split under the live
+    topology.  This is the prediction reconcile_drain holds the
+    measured counters against."""
     from .parallel import dist as PAR
+    from .parallel import topology as _topo
 
     bw = max(int(batch), 1)
-    count, nbytes = _predict_cached(
+    count, nbytes, (ici_b, dcn_b) = _predict_cached(
         tuple(tuple(b) for b in bit_sets), n, nloc, nsh,
-        tuple(perm0) if perm0 is not None else None, itemsize)
+        tuple(perm0) if perm0 is not None else None, itemsize,
+        _topo.signature(1 << nsh))
     return {"count": count * bw, "nbytes": nbytes * bw,
+            "tier_nbytes": {"ici": ici_b * bw, "dcn": dcn_b * bw},
             "chunks": str(PAR.exchange_config_key() or "auto")}
 
 
 def reconcile_drain(*, bit_sets: Sequence, n: int, nloc: int, nsh: int,
                     perm0, itemsize: int, batch: int,
                     measured_count: float, measured_bytes: float,
-                    measured_chunks: str) -> Optional[dict]:
+                    measured_chunks: str,
+                    measured_tier_bytes: Optional[dict] = None
+                    ) -> Optional[dict]:
     """Compare a drain's measured window-remap telemetry deltas against
     the independent plan prediction.  Records the prediction into
     ``predicted_exchanges_total`` / ``predicted_exchange_bytes_total``
-    (reportPerf's predicted-vs-measured section); any deviation
-    increments ``model_drift_total{kind}`` per drifting dimension
-    (count / bytes / chunks) and emits ONE structured JSON log line.
-    Returns the drift dict (empty when the model holds)."""
+    (reportPerf's predicted-vs-measured section; bytes carry the
+    per-interconnect ``tier`` label so the per-tier series reconcile
+    too); any deviation increments ``model_drift_total{kind}`` per
+    drifting dimension (count / bytes / chunks / tier_bytes) and emits
+    ONE structured JSON log line.  Returns the drift dict (empty when
+    the model holds)."""
     if not _telemetry.enabled():
         return None
     pred = predict_window_exchanges(bit_sets, n, nloc, nsh, perm0,
@@ -620,9 +687,10 @@ def reconcile_drain(*, bit_sets: Sequence, n: int, nloc: int, nsh: int,
     if pred["count"]:
         _telemetry.inc("predicted_exchanges_total", pred["count"],
                        op="window_remap")
-    if pred["nbytes"]:
-        _telemetry.inc("predicted_exchange_bytes_total", pred["nbytes"],
-                       op="window_remap")
+    for tier, b in pred["tier_nbytes"].items():
+        if b:
+            _telemetry.inc("predicted_exchange_bytes_total", b,
+                           op="window_remap", tier=tier)
     drift: dict = {}
     if int(measured_count) != int(pred["count"]):
         drift["count"] = {"predicted": int(pred["count"]),
@@ -630,6 +698,12 @@ def reconcile_drain(*, bit_sets: Sequence, n: int, nloc: int, nsh: int,
     if int(measured_bytes) != int(pred["nbytes"]):
         drift["bytes"] = {"predicted": int(pred["nbytes"]),
                           "measured": int(measured_bytes)}
+    if measured_tier_bytes is not None:
+        for tier, b in pred["tier_nbytes"].items():
+            if int(measured_tier_bytes.get(tier, 0)) != int(b):
+                drift.setdefault("tier_bytes", {})[tier] = {
+                    "predicted": int(b),
+                    "measured": int(measured_tier_bytes.get(tier, 0))}
     if (pred["count"] or measured_count) and \
             str(measured_chunks) != str(pred["chunks"]):
         drift["chunks"] = {"predicted": str(pred["chunks"]),

@@ -55,17 +55,55 @@ from .. import telemetry as _telemetry
 from ..contracts import sharded_contract
 from ..env import AMP_AXIS, shard_map
 from ..ops import cplx, kernels
+from . import topology as topo
 
 _CONFIG = {"explicit": True, "lazy_remap": True}
 
 
-def _record_exchange(amps, op: str, count: int, nbytes: int, chunks) -> None:
+def mesh_topology(mesh: Mesh) -> topo.Topology:
+    """The live hierarchical arrangement of this mesh's amplitude axis
+    (``QT_TOPOLOGY``; single-host fallback — parallel/topology.py)."""
+    return topo.resolve(amp_axis_size(mesh))
+
+
+def _record_exchange(amps, op: str, count: int, nbytes: int, chunks,
+                     tier: str = "ici") -> None:
     """Dispatch-time exchange accounting (telemetry.record_exchange):
     skipped for traced operands — a wrapper reached from inside a user
     jit body would otherwise count once per TRACE, not per execution."""
     if not _telemetry.enabled() or isinstance(amps, jax.core.Tracer):
         return
-    _telemetry.record_exchange(op, count, nbytes, chunks=str(chunks))
+    _telemetry.record_exchange(op, count, nbytes, chunks=str(chunks),
+                               tier=tier)
+
+
+def _record_exchange_tiers(amps, op: str, parts, chunks) -> None:
+    """Per-tier dispatch accounting: ``parts`` maps tier ->
+    (count, nbytes); one record_exchange per nonzero tier, so the tier
+    series sum exactly to the flat accounting of the same program."""
+    if not _telemetry.enabled() or isinstance(amps, jax.core.Tracer):
+        return
+    for tier, (count, nbytes) in parts.items():
+        if count or nbytes:
+            _telemetry.record_exchange(op, count, nbytes,
+                                       chunks=str(chunks), tier=tier)
+
+
+def _sweep_exchange_tiers(nex: int, r: int, payload: int,
+                          t: "topo.Topology", composed: bool) -> dict:
+    """Tier split of a mesh-bit SWEEP op (Trotter / PauliSum rotation
+    layers): ``nex`` full-shard exchanges spread uniformly over the
+    ``r`` mesh bits, so the DCN share is exactly ``nex * dcn_bits / r``
+    (nex is a multiple of r for the layered bodies).  ``composed`` marks
+    the direct-gather bodies whose single composed mesh-flip ppermute
+    per term may touch ANY mesh bit — conservatively DCN on a multi-host
+    topology."""
+    if composed:
+        tier = "dcn" if t.dcn_bits else "ici"
+        return {tier: (nex, nex * payload)}
+    dcn_n = nex * t.dcn_bits // max(r, 1)
+    return {"ici": (nex - dcn_n, (nex - dcn_n) * payload),
+            "dcn": (dcn_n, dcn_n * payload)}
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +195,15 @@ def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
             _telemetry.inc("exchange_timeouts_total", op=op)
             raise ShardLossError(
                 f"injected shard loss during {op} dispatch", op=op)
+        if fault == "host_loss":
+            # a whole host's shards die at once: report the highest shard
+            # as the observed casualty — the failover maps it back to its
+            # host (topology.host_of) and excludes that host's entire
+            # device range from the surviving mesh
+            _telemetry.inc("exchange_timeouts_total", op=op)
+            raise ShardLossError(
+                f"injected host loss during {op} dispatch", op=op,
+                shard=int(shards) - 1)
         if fault == "stall":
             _telemetry.inc("exchange_timeouts_total", op=op)
             last = TimeoutError(f"injected stall during {op} dispatch")
@@ -423,7 +470,8 @@ def _shard_coeffs(rmat_like, mybit):
 
 
 @sharded_contract(collectives={"collective-permute": 1},
-                  max_exchange_bytes=1 << 10)
+                  max_exchange_bytes=1 << 10,
+                  max_tier_bytes={"ici": 1 << 10, "dcn": 1 << 10})
 def apply_matrix_1q_sharded(
     amps,
     matrix,
@@ -451,7 +499,9 @@ def apply_matrix_1q_sharded(
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
     _record_exchange(amps, "matrix_1q", 1, _shard_payload_bytes(amps, mesh),
-                     chunks)
+                     chunks,
+                     tier=mesh_topology(mesh).tier_of_bit(
+                         target - (num_qubits - num_shard_bits(mesh))))
     return guarded_dispatch(
         _apply_matrix_1q_sharded, amps, matrix,
         op="matrix_1q", shards=amp_axis_size(mesh),
@@ -536,7 +586,8 @@ def _apply_matrix_1q_sharded(
 
 
 @sharded_contract(collectives={"collective-permute": 1},
-                  max_exchange_bytes=1 << 9)
+                  max_exchange_bytes=1 << 9,
+                  max_tier_bytes={"ici": 1 << 9, "dcn": 1 << 9})
 def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
                  qb_high: int, chunks: Optional[int] = None):
     """SWAP between a local qubit and a sharded qubit: exchange only the
@@ -553,7 +604,9 @@ def swap_sharded(amps, *, mesh: Mesh, num_qubits: int, qb_low: int,
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh) // 2)
     _record_exchange(amps, "swap", 1, _shard_payload_bytes(amps, mesh) // 2,
-                     chunks)
+                     chunks,
+                     tier=mesh_topology(mesh).tier_of_bit(
+                         qb_high - (num_qubits - num_shard_bits(mesh))))
     return guarded_dispatch(
         _swap_sharded, amps, op="swap", shards=amp_axis_size(mesh),
         mesh=mesh, num_qubits=num_qubits,
@@ -600,8 +653,15 @@ def gather_replicated(amps, *, mesh: Mesh):
     reference's ring-of-broadcasts copyVecIntoMatrixPairState
     (QuEST_cpu_distributed.c:379-423), used to build rho = |psi><psi|."""
     ndev = amp_axis_size(mesh)
-    _record_exchange(amps, "gather", 1,
-                     _shard_payload_bytes(amps, mesh) * (ndev - 1), 1)
+    t = mesh_topology(mesh)
+    payload = _shard_payload_bytes(amps, mesh)
+    # each shard receives ndev-1 peer shards: chips-1 of them over ICI,
+    # the rest across hosts — the count rides the slower tier
+    dcn_b = payload * (ndev - t.chips)
+    _record_exchange_tiers(
+        amps, "gather",
+        {"ici": (0 if dcn_b else 1, payload * (t.chips - 1)),
+         "dcn": (1 if dcn_b else 0, dcn_b)}, 1)
     return guarded_dispatch(_gather_replicated, amps, op="gather",
                             shards=ndev, mesh=mesh)
 
@@ -638,7 +698,8 @@ def _pair_channel_weights(kind: str, p, ktv, btv, dt):
 
 
 @sharded_contract(collectives={"collective-permute": 1},
-                  max_exchange_bytes=1 << 10)
+                  max_exchange_bytes=1 << 10,
+                  max_tier_bytes={"ici": 1 << 10, "dcn": 1 << 10})
 def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
                              target: int, kind: str,
                              chunks: Optional[int] = None):
@@ -655,8 +716,16 @@ def mix_pair_channel_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     take the elementwise kernels (ops/density.py)."""
     if chunks is None:
         chunks = exchange_chunks(_shard_payload_bytes(amps, mesh))
+    # partner shard = XOR on the bra mesh bit (and the ket mesh bit too
+    # when both are sharded) — the hop crosses DCN iff any flipped
+    # mesh-coordinate bit addresses the host
+    nloc = 2 * num_qubits - num_shard_bits(mesh)
+    xor_mask = 1 << (target + num_qubits - nloc)
+    if target >= nloc:
+        xor_mask |= 1 << (target - nloc)
     _record_exchange(amps, "pair_channel", 1,
-                     _shard_payload_bytes(amps, mesh), chunks)
+                     _shard_payload_bytes(amps, mesh), chunks,
+                     tier=mesh_topology(mesh).tier_of_mask(xor_mask))
     return guarded_dispatch(
         _mix_pair_channel_sharded, amps, prob,
         op="pair_channel", shards=amp_axis_size(mesh),
@@ -910,8 +979,10 @@ def trotter_scan_sharded(amps, codes_seq, angles, *, mesh: Mesh,
     else:
         nex = 2 * r * nterms
     if nex:
-        _record_exchange(amps, "trotter", nex,
-                         nex * _shard_payload_bytes(amps, mesh), chunks)
+        _record_exchange_tiers(
+            amps, "trotter",
+            _sweep_exchange_tiers(nex, r, _shard_payload_bytes(amps, mesh),
+                                  mesh_topology(mesh), direct), chunks)
     return _trotter_scan_sharded(
         amps, codes_seq, angles, mesh=mesh, num_qubits=num_qubits,
         rep_qubits=rep_qubits, chunks=int(chunks))
@@ -999,8 +1070,10 @@ def expec_pauli_sum_scan_sharded(amps, codes_seq, coeffs, *, mesh: Mesh,
     else:
         nex = r * nterms
     if nex:
-        _record_exchange(amps, "expec", nex,
-                         nex * _shard_payload_bytes(amps, mesh), chunks)
+        _record_exchange_tiers(
+            amps, "expec",
+            _sweep_exchange_tiers(nex, r, _shard_payload_bytes(amps, mesh),
+                                  mesh_topology(mesh), direct), chunks)
     return _expec_pauli_sum_scan_sharded(
         amps, codes_seq, coeffs, mesh=mesh, num_qubits=num_qubits,
         quad=quad, chunks=int(chunks))
@@ -1083,10 +1156,22 @@ def mix_two_qubit_depol_sharded(amps, prob, *, mesh: Mesh, num_qubits: int,
     elementwise combine (see ops/density.mix_two_qubit_depolarising for
     the block formula)."""
     nloc = 2 * num_qubits - num_shard_bits(mesh)
-    nex = sum(1 for q in (qubit1, qubit2) if q + num_qubits >= nloc)
-    if nex:
-        _record_exchange(amps, "depol2", nex,
-                         nex * _shard_payload_bytes(amps, mesh), 1)
+    t = mesh_topology(mesh)
+    payload = _shard_payload_bytes(amps, mesh)
+    parts = {"ici": [0, 0], "dcn": [0, 0]}
+    for q in (qubit1, qubit2):
+        b = q + num_qubits
+        if b < nloc:
+            continue  # double flip fully shard-local: no exchange
+        xor_mask = 1 << (b - nloc)
+        if q >= nloc:
+            xor_mask |= 1 << (q - nloc)
+        acc = parts[t.tier_of_mask(xor_mask)]
+        acc[0] += 1
+        acc[1] += payload
+    if parts["ici"][0] or parts["dcn"][0]:
+        _record_exchange_tiers(
+            amps, "depol2", {k: tuple(v) for k, v in parts.items()}, 1)
     return _mix_two_qubit_depol_sharded(
         amps, prob, mesh=mesh, num_qubits=num_qubits, qubit1=qubit1,
         qubit2=qubit2)
@@ -1271,10 +1356,20 @@ def fused_qft_sharded(amps, *, mesh: Mesh, num_qubits: int,
     if r:
         payload = _shard_payload_bytes(amps, mesh)
         ndev = amp_axis_size(mesh)
-        # r full-shard H-exchanges + the reversal all_to_all, which moves
-        # every block but the diagonal one: (ndev-1)/ndev of a shard
-        _record_exchange(amps, "qft", r + 1,
-                         r * payload + (payload * (ndev - 1)) // ndev, 1)
+        t = mesh_topology(mesh)
+        # r full-shard H-exchanges (one per mesh bit, so the tier split
+        # is exactly per-bit) + the reversal all_to_all, which moves
+        # every block but the diagonal one: (ndev-1)/ndev of a shard —
+        # ndev-chips of those blocks cross hosts
+        a2a_total = (payload * (ndev - 1)) // ndev
+        a2a_dcn = (payload * (ndev - t.chips)) // ndev
+        multi = t.dcn_bits > 0
+        _record_exchange_tiers(
+            amps, "qft",
+            {"ici": (t.ici_bits + (0 if multi else 1),
+                     t.ici_bits * payload + (a2a_total - a2a_dcn)),
+             "dcn": (t.dcn_bits + (1 if multi else 0),
+                     t.dcn_bits * payload + a2a_dcn)}, 1)
     return _fused_qft_sharded(amps, mesh=mesh, num_qubits=num_qubits,
                               conj=conj)
 
@@ -1422,6 +1517,43 @@ def qft_runs_exchange_model(runs, nloc: int, itemsize: int = 8):
     return count, nbytes
 
 
+def qft_runs_exchange_tiers(runs, nloc: int, itemsize: int = 8,
+                            topology: Optional["topo.Topology"] = None):
+    """Tier split of qft_runs_exchange_model: each mesh-bit layer and
+    each mixed reversal pair carries a specific mesh bit (its tier is
+    that bit's), the composed mesh<->mesh reversal ppermute is DCN iff
+    it moves a host bit.  Sums exactly to the flat model."""
+    t = topology
+    shard = 2 * (1 << nloc) * itemsize
+    parts = {"ici": [0, 0], "dcn": [0, 0]}
+
+    def tier_of(mesh_bit):
+        return t.tier_of_bit(mesh_bit) if t is not None else "ici"
+
+    for base, cnt, _conj in runs:
+        top = base + cnt
+        for q in range(max(base, nloc), top):      # mesh-bit layers
+            acc = parts[tier_of(q - nloc)]
+            acc[0] += 1
+            acc[1] += shard
+        mesh_mask = 0
+        for i in range(cnt // 2):
+            p, q = base + i, top - 1 - i
+            if q < nloc:
+                continue
+            if p >= nloc:
+                mesh_mask |= (1 << (p - nloc)) | (1 << (q - nloc))
+            else:
+                acc = parts[tier_of(q - nloc)]     # mixed half-shard swap
+                acc[0] += 1
+                acc[1] += shard // 2
+        if mesh_mask:
+            tier = (t.tier_of_mask(mesh_mask) if t is not None else "ici")
+            parts[tier][0] += 1
+            parts[tier][1] += shard
+    return {k: (v[0], v[1]) for k, v in parts.items()}
+
+
 def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
                            runs: Tuple[Tuple[int, int, bool], ...]):
     """QFT over contiguous qubit runs [(base, count, conj), ...] of a
@@ -1443,9 +1575,12 @@ def fused_qft_runs_sharded(amps, *, mesh: Mesh, num_qubits: int,
     Collectives for a run with s sharded bits: s ppermutes (layers) +
     at most s reversal ppermutes; fully-local runs cost zero."""
     nloc = num_qubits - num_shard_bits(mesh)
-    cnt, nbytes = qft_runs_exchange_model(runs, nloc, amps.dtype.itemsize)
+    cnt, _nbytes = qft_runs_exchange_model(runs, nloc, amps.dtype.itemsize)
     if cnt:
-        _record_exchange(amps, "qft_runs", cnt, nbytes, 1)
+        _record_exchange_tiers(
+            amps, "qft_runs",
+            qft_runs_exchange_tiers(runs, nloc, amps.dtype.itemsize,
+                                    mesh_topology(mesh)), 1)
     return _fused_qft_runs_sharded(amps, mesh=mesh, num_qubits=num_qubits,
                                    runs=tuple(runs))
 
@@ -1564,6 +1699,34 @@ def remap_exchange_count(sigma: Tuple[int, ...], nloc: int, r: int) -> int:
     return len(mixed) + (1 if mesh_tau is not None else 0)
 
 
+def remap_exchange_tiers(sigma: Tuple[int, ...], nloc: int, r: int,
+                         itemsize: int = 8,
+                         topology: Optional["topo.Topology"] = None):
+    """Per-tier (count, per-shard bytes) split of one remap's exchange
+    program — circuit.remap_exchange_bytes refined by interconnect: each
+    mixed half-shard swap carries exactly its mesh bit's tier; the
+    composed full-shard ppermute is DCN iff it moves any host bit.
+    Tier sums equal the flat (remap_exchange_count,
+    remap_exchange_bytes) pair exactly."""
+    t = topology if topology is not None else topo.resolve(1 << r)
+    mixed, _local_perm, mesh_tau = decompose_sigma(tuple(sigma), nloc, r)
+    shard = 2 * (1 << nloc) * itemsize
+    parts = {"ici": [0, 0], "dcn": [0, 0]}
+    for _lb, mb in mixed:
+        acc = parts[t.tier_of_bit(mb)]
+        acc[0] += 1
+        acc[1] += shard // 2
+    if mesh_tau is not None:
+        moved = 0
+        for b, dst in enumerate(mesh_tau):
+            if b != dst:
+                moved |= (1 << b) | (1 << dst)
+        acc = parts[t.tier_of_mask(moved)]
+        acc[0] += 1
+        acc[1] += shard
+    return {k: (v[0], v[1]) for k, v in parts.items()}
+
+
 def remap_chunk_plan(nloc: int, itemsize: int = 8,
                      backend: Optional[str] = None) -> Tuple[int, int]:
     """The (half_shard_chunks, full_shard_chunks) pair the
@@ -1591,6 +1754,15 @@ def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
     exchange_config_key() so an env-override flip retraces."""
     r = int(math.log2(ndev))
     mixed, local_perm, mesh_tau = decompose_sigma(sigma, nloc, r)
+    t = topo.resolve(ndev)
+    if t.dcn_bits and len(mixed) > 1:
+        # DCN-overlap schedule (§17 generalized, docs/design.md §25):
+        # issue the slow cross-host half-shard swaps FIRST so XLA's
+        # latency-hiding scheduler overlaps their transfers against the
+        # subsequent intra-host swaps and the local permute.  Mixed
+        # transpositions touch disjoint (local, mesh) bit pairs, so any
+        # ordering computes the identical state.
+        mixed = tuple(sorted(mixed, key=lambda lm: lm[1] < t.ici_bits))
     if chunks is None:
         chunks = remap_chunk_plan(nloc, local.dtype.itemsize)
     ch_half = min(_pow2_floor(chunks[0]), 1 << max(nloc - 1, 0))
@@ -1614,7 +1786,8 @@ def _remap_in_shard(local, sigma: Tuple[int, ...], nloc: int, ndev: int,
 
 
 @sharded_contract(collectives={"collective-permute": 1},
-                  max_exchange_bytes=1 << 9)
+                  max_exchange_bytes=1 << 9,
+                  max_tier_bytes={"ici": 1 << 9, "dcn": 1 << 9})
 def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
                   sigma: Tuple[int, ...],
                   chunks: Optional[Tuple[int, int]] = None):
@@ -1629,18 +1802,16 @@ def remap_sharded(amps, *, mesh: Mesh, num_qubits: int,
         nbytes = _shard_payload_bytes(amps, mesh)
         chunks = (exchange_chunks(nbytes // 2), exchange_chunks(nbytes))
     if _telemetry.enabled() and not isinstance(amps, jax.core.Tracer):
-        from .. import circuit as CIRC
-
         r = num_shard_bits(mesh)
         nloc = num_qubits - r
-        cnt = remap_exchange_count(tuple(sigma), nloc, r)
         bw = int(amps.shape[0]) if amps.ndim == 3 else 1
-        if cnt:
-            _telemetry.record_exchange(
-                "remap", cnt * bw,
-                bw * CIRC.remap_exchange_bytes(tuple(sigma), num_qubits,
-                                               nloc, amps.dtype.itemsize),
-                chunks=str(chunks))
+        tiers = remap_exchange_tiers(tuple(sigma), nloc, r,
+                                     amps.dtype.itemsize,
+                                     mesh_topology(mesh))
+        _record_exchange_tiers(
+            amps, "remap",
+            {k: (c * bw, b * bw) for k, (c, b) in tiers.items()},
+            str(chunks))
     return guarded_dispatch(
         _remap_sharded, amps, op="remap", shards=amp_axis_size(mesh),
         mesh=mesh, num_qubits=num_qubits, sigma=tuple(sigma),
@@ -1685,13 +1856,23 @@ def canonical_sigma(perm: Tuple[int, ...]) -> Tuple[int, ...]:
 
 
 def plan_window_remap(num_qubits: int, nloc: int, perm: Tuple[int, ...],
-                      want_local, next_use=None):
+                      want_local, next_use=None, topology=None):
     """Choose the minimal-movement permutation making every logical qubit
     in ``want_local`` shard-local: qubits already local stay put; each
     sharded one swaps with the local slot whose resident logical qubit is
     needed FURTHEST in the future (``next_use``: logical qubit -> distance
     of its next use; absent = never used again, evicted first — the same
     lookahead policy as the paged planner's eviction choice).
+
+    On a hierarchical topology (``topology``; default resolved from the
+    mesh size via QT_TOPOLOGY) the planner is additionally TIER-aware:
+    wanted qubits currently parked on DCN mesh bits are serviced first,
+    so the coldest evictees (front of the eviction pool) land on the
+    slow cross-host slots and the hotter ones stay on intra-host ICI
+    axes — later windows that re-fetch them pay ICI, not DCN, bytes.
+    The permutation itself is identical in shape (same number of mixed
+    swaps), results are bit-identical; only WHERE evictees park changes.
+    QT_TOPOLOGY_PLANNER=flat restores the flat ordering for A/B runs.
 
     Returns (sigma | None, new_perm): ``sigma`` is None when nothing
     moves; (None, None) when ``want_local`` exceeds the local capacity —
@@ -1707,6 +1888,14 @@ def plan_window_remap(num_qubits: int, nloc: int, perm: Tuple[int, ...],
     need = [q for q in want_local if perm[q] >= nloc]
     if not need:
         return None, tuple(perm)
+    if topology is None:
+        topology = topo.resolve(1 << max(num_qubits - nloc, 0))
+    if topo.hierarchical_enabled(topology):
+        # DCN-resident wanted qubits first (highest mesh bit first within
+        # the tier): they consume the coldest pool slots, which are the
+        # ones later evictions would otherwise have to push cross-host.
+        need.sort(key=lambda q: (perm[q] - nloc < topology.ici_bits,
+                                 -(perm[q] - nloc)))
     wanted = set(want_local)
     pool = [p for p in range(nloc) if inv[p] not in wanted]
     assert len(pool) >= len(need)  # guaranteed by |want_local| <= nloc

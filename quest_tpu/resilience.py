@@ -196,6 +196,12 @@ class FaultPlan:
     - ``shard_loss@W`` a shard dies during window W's exchange dispatch:
                       the guard raises dist.ShardLossError and
                       run_resumable fails over (rollback + mesh shrink)
+    - ``host_loss@W`` a whole HOST's shards die during window W's
+                      exchange dispatch (hierarchical topology,
+                      parallel/topology.py): the ShardLossError carries
+                      the observed shard, and the failover excludes the
+                      dead host's entire device range so the surviving
+                      mesh is built from intact hosts (2x4 -> 1x4)
     - ``oom@W``       window W's drain dispatch raises a synthetic
                       RESOURCE_EXHAUSTED once — caught by the memory
                       governor's OOM net (governor.oom_net), which
@@ -207,7 +213,7 @@ class FaultPlan:
     plan actually executed."""
 
     _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale",
-              "stall", "shard_loss", "oom")
+              "stall", "shard_loss", "host_loss", "oom")
 
     def __init__(self, spec: str = ""):
         self.events: List[Tuple[str, int]] = []
@@ -221,6 +227,7 @@ class FaultPlan:
         # scope
         self._stalls_pending = 0
         self._loss_pending = False
+        self._host_loss_pending = False
         self._oom_pending = 0
         spec = (spec or "").strip()
         if spec:
@@ -273,6 +280,8 @@ class FaultPlan:
             self._stalls_pending += 1
         if self._fire("shard_loss", window):
             self._loss_pending = True
+        if self._fire("host_loss", window):
+            self._host_loss_pending = True
         self.arm_oom(window)
 
     def arm_oom(self, window: int) -> None:
@@ -288,6 +297,9 @@ class FaultPlan:
         if self._loss_pending:
             self._loss_pending = False
             return "shard_loss"
+        if self._host_loss_pending:
+            self._host_loss_pending = False
+            return "host_loss"
         if self._stalls_pending > 0:
             self._stalls_pending -= 1
             return "stall"
@@ -866,11 +878,26 @@ def _failover(qureg, ckpt_dir: str, err, *, run_id: str, t_run: float,
         raise err
     new_n = old_n // 2
     detect_s = (t_detect - window_started) if window_started else 0.0
+    # host-aware exclusion (parallel/topology.py): when the loss names a
+    # shard and the mesh is hierarchical, the whole host holding that
+    # shard is presumed dead — its entire device range is excluded so
+    # the surviving mesh is built from intact hosts only (a 2x4
+    # arrangement fails over onto the other host's 1x4, not onto a mix
+    # of live and dead chips)
+    dead_host = None
+    excl = None
+    topology = getattr(qureg.env, "topology", None)
+    if (err.shard is not None and topology is not None
+            and topology.hosts > 1):
+        dead_host = topology.host_of(int(err.shard))
+        excl = list(topology.host_range(dead_host))
+        if old_n - len(excl) < new_n:
+            excl = excl[:old_n - new_n]
     # rollback: pick + read the last-good generation, restoring its raw
     # payload directly into the SHRUNKEN mesh's sharding (the elastic
     # path — one restore does both the rollback and the reshard IO)
     t0 = time.perf_counter()
-    new_env = _env.shrink_env(qureg.env, new_n)
+    new_env = _env.shrink_env(qureg.env, new_n, exclude_indices=excl)
     loaded = load_latest(ckpt_dir, new_env)
     rollback_s = time.perf_counter() - t0
     if loaded is None:
@@ -889,12 +916,14 @@ def _failover(qureg, ckpt_dir: str, err, *, run_id: str, t_run: float,
     _telemetry.set_gauge("failover_detect_seconds", detect_s)
     _telemetry.set_gauge("failover_rollback_seconds", rollback_s)
     _telemetry.set_gauge("failover_reshard_seconds", reshard_s)
+    host_note = (f" (host {dead_host} excluded)"
+                 if dead_host is not None else "")
     record_degradation(
         f"mesh_failover_{old_n}to{new_n}",
         f"shard loss during {err.op!r} dispatch ({err}); mesh shrunk "
-        f"{old_n}->{new_n}, resumed from gate cursor {cursor}")
+        f"{old_n}->{new_n}{host_note}, resumed from gate cursor {cursor}")
     _log_event(run_id, "failover", op=err.op, from_shards=old_n,
-               to_shards=new_n, cursor=cursor,
+               to_shards=new_n, cursor=cursor, dead_host=dead_host,
                detect_seconds=round(detect_s, 4),
                rollback_seconds=round(rollback_s, 4),
                reshard_seconds=round(reshard_s, 4),

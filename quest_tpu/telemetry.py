@@ -232,16 +232,24 @@ def observe(name: str, value: float, /, **labels) -> None:
 
 
 def record_exchange(op: str, count: int = 1, nbytes: int = 0, *,
-                    chunks="auto") -> None:
-    """One call per dispatched exchange program: ``count`` collective
-    transfers moving ``nbytes`` PER-SHARD ICI bytes total (the same
-    accounting unit as circuit.remap_exchange_bytes), labeled with the
-    op family and the resolved chunk configuration."""
+                    chunks="auto", tier: str = "ici") -> None:
+    """One call per dispatched exchange program AND per interconnect
+    tier: ``count`` collective transfers moving ``nbytes`` PER-SHARD
+    bytes total over ``tier`` ("ici" intra-host / "dcn" cross-host —
+    parallel/topology.py; the byte unit matches
+    circuit.remap_exchange_bytes), labeled with the op family and the
+    resolved chunk configuration.  A mixed-tier program (e.g. a window
+    remap whose transpositions straddle the host boundary) records once
+    per tier with the exact per-tier split, so summing the tier series
+    reproduces the flat totals (pinned in tests/test_telemetry.py).  A
+    zero ``count`` still records bytes — byte-only attributions (the
+    all-gather's cross-host share) keep the count on one tier."""
     if not _mode:
         return
-    inc("exchanges_total", count, op=op, chunks=chunks)
+    if count:
+        inc("exchanges_total", count, op=op, chunks=chunks, tier=tier)
     if nbytes:
-        inc("exchange_bytes_total", nbytes, op=op)
+        inc("exchange_bytes_total", nbytes, op=op, tier=tier)
 
 
 # ---------------------------------------------------------------------------
@@ -515,6 +523,18 @@ def perf_report(env=None) -> str:
                     f"  {name}{tag}: count={hd['count']} "
                     f"sum={hd['sum']:.6g} mean={mean:.6g} "
                     f"max={hd['max'] if hd['max'] is not None else '-'}")
+    # per-tier exchange volume (parallel/topology.py): the ici/dcn split
+    # of every exchange series — sums exactly to the flat totals
+    tier_lines = []
+    for tier in ("ici", "dcn"):
+        tc = counter_sum("exchanges_total", tier=tier)
+        tb = counter_sum("exchange_bytes_total", tier=tier)
+        if tc or tb:
+            tier_lines.append(f"  {tier}: exchanges={_num(tc)} "
+                              f"bytes/shard={_num(tb)}")
+    if tier_lines:
+        lines.append("exchange tiers (per-shard bytes by interconnect):")
+        lines.extend(tier_lines)
     pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
     meas_c = counter_sum("exchanges_total", op="window_remap")
     pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")

@@ -1,0 +1,197 @@
+"""Pod-topology A/B (ISSUE 12 acceptance): the tier-aware remap planner
+must cut cross-host (DCN) exchange traffic >= 2x vs flat planning on an
+emulated slow-DCN 2x4 topology, with bit-identical amplitudes.
+
+Both arms run the SAME config-6-style churn workload — a periodic
+stream of 2q/3q unitaries cycling more distinct hot qubits than fit in
+a shard (so every fusion window evicts something it will want back) —
+on the 8-shard CPU dryrun read as 2 hosts x 4 chips (``QT_TOPOLOGY=2x4``,
+mesh bit 2 = the host axis).  The flat arm (``QT_TOPOLOGY_PLANNER=flat``)
+evicts in request order and keeps parking soon-reused qubits on the
+cross-host mesh bit, paying a DCN hop to fetch them back every cycle;
+the hierarchical arm parks the coldest evictee there, so after warmup
+the DCN slot holds a dead qubit and the churn stays on ICI.
+
+Two numbers gate, both per arm:
+
+* MODELED per-tier bytes — ``explainCircuit`` totals (the tier-aware
+  cost model, windows + final canonical read);
+* MEASURED per-tier bytes — the ``exchange_bytes_total{tier}`` counters
+  after actually draining (``model_drift_total`` must stay 0, so the
+  two agree by construction — measuring both proves it end to end).
+
+Usage: python scripts/bench_pod.py [--n 10] [--reps 10]
+       [--budget 2.0] [--no-check]
+Needs the 8-device virtual mesh: run under
+XLA_FLAGS=--xla_force_host_platform_device_count=8 (make verify-pod).
+Exits non-zero when either reduction lands under the budget (unless
+--no-check).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("QT_TOPOLOGY", "2x4")
+os.environ.setdefault("QT_TIER_WEIGHT_DCN", "8")
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import telemetry as T  # noqa: E402
+from quest_tpu.parallel import topology as TOPO  # noqa: E402
+
+# one period of the churn stream (qubit tuples per gate).  With n=10 and
+# nloc=7 the working set cycles 10 logical qubits through 7 local slots:
+# every window needs qubits parked on BOTH mesh tiers, which is exactly
+# where the flat planner's request-order eviction pairing goes wrong.
+PERIOD = [(7, 9), (0, 8, 9), (1, 7, 8), (5, 9), (2, 3, 8), (1, 2, 6)]
+
+
+def _arg(flag, default, cast=int):
+    return cast(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def _unitary(rng, k):
+    d = 1 << k
+    g = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
+    u, _r = np.linalg.qr(g)
+    return u
+
+
+def _gates(n, reps, seed=11):
+    rng = np.random.default_rng(seed)
+    stream = []
+    for _ in range(reps):
+        for ts in PERIOD:
+            assert max(ts) < n
+            stream.append((ts, _unitary(rng, len(ts))))
+    return stream
+
+
+def _run_arm(env, planner, n, reps):
+    """One planner arm: dry-run model totals, then drain + measure."""
+    os.environ[TOPO.PLANNER_ENV] = planner
+    stream = _gates(n, reps)
+
+    # modeled: the dry-run explainer on a buffered (undrained) qureg
+    q = qt.createQureg(n, env)
+    qt.startGateFusion(q)
+    for ts, u in stream:
+        qt.multiQubitUnitary(q, list(ts), u)
+    report = qt.explainCircuit(q)
+    # window totals + the final canonical read (reported separately,
+    # mirroring exchange_bytes vs exchange_bytes_with_read)
+    modeled = dict(report["totals"]["tier_bytes"])
+    if report["final_remap"]:
+        for tier, b in report["final_remap"]["tier_bytes"].items():
+            modeled[tier] = modeled.get(tier, 0) + b
+    weighted = report["totals"]["weighted_exchange_cost"]
+
+    # measured: drain the same buffer for real and read the counters
+    T.reset()
+    t0 = time.perf_counter()
+    amps = np.asarray(q.amps)
+    seconds = time.perf_counter() - t0
+    measured = {
+        tier: int(T.counter_sum("exchange_bytes_total",
+                                op="window_remap", tier=tier)
+                  + T.counter_sum("exchange_bytes_total",
+                                  op="remap", tier=tier))
+        for tier in TOPO.TIERS}
+    drift = T.counter_total("model_drift_total")
+    return {"planner": planner, "modeled": modeled, "measured": measured,
+            "weighted_cost": weighted, "drift": int(drift),
+            "seconds": round(seconds, 4)}, amps
+
+
+def _ratio(a, b):
+    return round(a / b, 2) if b else float("inf") if a else 1.0
+
+
+def run(n=10, reps=10):
+    env = qt.createQuESTEnv()
+    if env.num_devices < 8:
+        raise RuntimeError(
+            "bench_pod needs the 8-device virtual mesh — run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    topo = TOPO.resolve(env.num_devices)
+    if topo.dcn_bits == 0:
+        raise RuntimeError(
+            f"QT_TOPOLOGY={os.environ.get('QT_TOPOLOGY')} resolved flat "
+            f"on {env.num_devices} devices — the A/B needs a host axis")
+    prev_mode = T.mode_name()
+    prev_planner = os.environ.get(TOPO.PLANNER_ENV)
+    T.configure("on")
+    try:
+        flat, amps_flat = _run_arm(env, "flat", n, reps)
+        hier, amps_hier = _run_arm(env, "hier", n, reps)
+    finally:
+        T.reset()
+        T.configure(prev_mode)
+        if prev_planner is None:
+            os.environ.pop(TOPO.PLANNER_ENV, None)
+        else:
+            os.environ[TOPO.PLANNER_ENV] = prev_planner
+    return {
+        "bench": "pod_topology_ab",
+        "n": n, "reps": reps, "gates": reps * len(PERIOD),
+        "topology": topo.describe(),
+        "tier_weights": TOPO.tier_weights(),
+        "backend": jax.default_backend(),
+        "devices": env.num_devices,
+        "flat": flat, "hier": hier,
+        "modeled_dcn_reduction": _ratio(flat["modeled"].get("dcn", 0),
+                                        hier["modeled"].get("dcn", 0)),
+        "measured_dcn_reduction": _ratio(flat["measured"].get("dcn", 0),
+                                         hier["measured"].get("dcn", 0)),
+        "weighted_cost_reduction": _ratio(flat["weighted_cost"],
+                                          hier["weighted_cost"]),
+        "bit_identical": bool(np.array_equal(amps_flat, amps_hier)),
+    }
+
+
+def main():
+    budget = _arg("--budget", 2.0, float)
+    rec = run(n=_arg("--n", 10), reps=_arg("--reps", 10))
+    print(json.dumps(rec), flush=True)
+    if "--no-check" in sys.argv:
+        return 0
+    ok = True
+    if not rec["bit_identical"]:
+        print("FAIL: flat and hierarchical planner amplitudes differ — "
+              "topology must never change WHAT is computed",
+              file=sys.stderr)
+        ok = False
+    for arm in ("flat", "hier"):
+        if rec[arm]["drift"]:
+            print(f"FAIL: {arm} arm ended with model_drift_total="
+                  f"{rec[arm]['drift']} (predicted != measured)",
+                  file=sys.stderr)
+            ok = False
+        if rec[arm]["modeled"] != rec[arm]["measured"]:
+            print(f"FAIL: {arm} arm modeled tier bytes "
+                  f"{rec[arm]['modeled']} != measured "
+                  f"{rec[arm]['measured']}", file=sys.stderr)
+            ok = False
+    for kind in ("modeled", "measured"):
+        red = rec[f"{kind}_dcn_reduction"]
+        if red < budget:
+            print(f"FAIL: {kind} DCN byte reduction {red}x is below the "
+                  f"{budget:.1f}x budget", file=sys.stderr)
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -610,6 +610,26 @@ class TestContractHLO:
         assert any("swap_sharded" in e and "max_exchange_bytes" in e
                    for e in errors), errors
 
+    def test_perturbed_dcn_tier_cap_fails(self, env8):
+        """Satellite (ISSUE 12): per-tier caps verify against the
+        compiled routing tables under the forced 2x4 hosts x chips
+        reading of the canonical mesh — a DCN cap below the measured
+        cross-host payload must FAIL, not quietly pass.  The canonical
+        remap (bit 0 <-> bit n-1) is a mixed transposition on the host
+        mesh bit, so its collective-permute provably rides DCN."""
+        from quest_tpu.analysis import hlocheck
+        base = C.SHARDED_CONTRACTS["remap_sharded"]
+        perturbed = dict(C.SHARDED_CONTRACTS)
+        perturbed["remap_sharded"] = C.ShardedContract(
+            name="remap_sharded",
+            collectives=dict(base.collectives),
+            max_exchange_bytes=base.max_exchange_bytes,
+            max_tier_bytes={"ici": base.max_exchange_bytes, "dcn": 1})
+        errors = hlocheck.verify_sharded_contracts(
+            env=env8, contracts=perturbed)
+        assert any("remap_sharded" in e and "max_tier_bytes[dcn]" in e
+                   for e in errors), errors
+
     def test_unknown_contract_name_fails(self, env8):
         from quest_tpu.analysis import hlocheck
         perturbed = dict(C.SHARDED_CONTRACTS)

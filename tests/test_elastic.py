@@ -351,6 +351,38 @@ class TestFailover:
         # observable without touching telemetry internals
         assert "Failovers=" in qt.getEnvironmentString(env)
 
+    def test_host_loss_fails_over_onto_surviving_host(
+            self, ref4, tmp_path, monkeypatch, _clean_failover_state):
+        """Satellite (ISSUE 12): a lost HOST on the emulated 2x4
+        topology.  The fault reports a shard on host 1; the failover
+        excludes that host's whole device range and resumes on the
+        intact host's 1x4 mesh — bit-identically to the uninterrupted
+        4-device run."""
+        monkeypatch.setenv("QT_TOPOLOGY", "2x4")
+        henv = qt.createQuESTEnv()
+        if henv.num_devices < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        assert (henv.topology.hosts, henv.topology.chips) == (2, 4)
+        q = _fresh(henv)
+        plan = qt.FaultPlan("host_loss@2")
+        with pytest.warns(UserWarning, match="mesh_failover_8to4"):
+            qt.run_resumable(q, _circuit(), str(tmp_path / "ck"),
+                             every=EVERY, faults=plan)
+        assert plan.log == ["host_loss@2"]
+        # the survivors are the intact host: chips preserved, one host
+        assert q.env.num_devices == 4
+        assert (q.env.topology.hosts, q.env.topology.chips) == (1, 4)
+        assert np.array_equal(np.asarray(q.amps), ref4[0])
+        report = qt.degradation_report()["mesh_failover_8to4"]
+        assert "(host 1 excluded)" in report
+
+    def test_host_loss_elastic_false_propagates(self, env, tmp_path):
+        q = _fresh(env)
+        with pytest.raises(PAR.ShardLossError):
+            qt.run_resumable(q, _circuit(), str(tmp_path / "ck"),
+                             every=EVERY, faults=qt.FaultPlan("host_loss@2"),
+                             elastic=False)
+
     def test_stall_absorbed_by_retry_budget(self, env, ref4, tmp_path):
         before = T.counter_total("exchange_timeouts_total")
         q = _fresh(env)

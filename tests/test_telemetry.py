@@ -379,9 +379,52 @@ class TestExchangeAccounting:
         assert got_bytes == exp_bytes
         assert got_count == exp_count
         # and both op families are present: the in-drain window remap
-        # and the canonical-order rematerialization on read
-        assert "op=window_remap" in snap["counters"]["exchange_bytes_total"]
-        assert "op=remap" in snap["counters"]["exchange_bytes_total"]
+        # and the canonical-order rematerialization on read (flat 1x8
+        # topology: every hop rides ICI)
+        assert "op=window_remap,tier=ici" \
+            in snap["counters"]["exchange_bytes_total"]
+        assert "op=remap,tier=ici" \
+            in snap["counters"]["exchange_bytes_total"]
+
+    def test_tier_split_sums_to_cost_model(self, env, monkeypatch):
+        """Satellite (ISSUE 12): under the emulated 2x4 topology the
+        tier-labeled byte series sum EXACTLY to the flat cost-model
+        totals, and each tier individually matches the tier-aware
+        model (circuit.remap_exchange_bytes_tiers)."""
+        monkeypatch.setenv("QT_TOPOLOGY", "2x4")
+        n, r = 6, dist.num_shard_bits(env.mesh)
+        nloc = n - r
+        rng = np.random.default_rng(3)
+        g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+        u, _ = np.linalg.qr(g)
+        q = qt.createQureg(n, env)
+        itemsize = np.dtype(q.dtype).itemsize
+        bit_sets = [(0, 1), (n - 2, n - 1), (0, 1)]
+        exp_count, exp_bytes = _expected_remap_cost(
+            bit_sets, n, nloc, r, itemsize)
+        # per-tier expectation straight from the tier-aware cost model,
+        # over the same sigmas the drain + final read will dispatch
+        exp_tier = {"ici": 0, "dcn": 0}
+        segments, final_perm = CIRC.plan_remap_windows(
+            bit_sets, n, nloc, None)
+        sigmas = [s for _ij, s, _p in segments if s is not None]
+        if final_perm is not None and list(final_perm) != list(range(n)):
+            sigmas.append(dist.canonical_sigma(final_perm))
+        for sigma in sigmas:
+            for tier, b in CIRC.remap_exchange_bytes_tiers(
+                    sigma, n, nloc, itemsize).items():
+                exp_tier[tier] += b
+        assert sum(exp_tier.values()) == exp_bytes  # model is a split
+        T.reset()
+        with qt.gateFusion(q):
+            for a, b in bit_sets:
+                qt.multiQubitUnitary(q, [a, b], u)
+        _ = qt.calcProbOfOutcome(q, 0, 0)
+        series = T.snapshot()["counters"]["exchange_bytes_total"]
+        got_tier = {t: sum(v for k, v in series.items()
+                           if f"tier={t}" in k) for t in ("ici", "dcn")}
+        assert got_tier == exp_tier
+        assert sum(got_tier.values()) == exp_bytes
 
     def test_eager_1q_exchange_payload(self, env):
         """A sharded-target 1q gate records one full-shard exchange with
@@ -396,9 +439,9 @@ class TestExchangeAccounting:
         shard_bytes = 2 * (1 << (n - dist.num_shard_bits(env.mesh))) \
             * amps.dtype.itemsize
         assert T.counter_value("exchanges_total",
-                               op="matrix_1q", chunks=2) == 1
+                               op="matrix_1q", chunks=2, tier="ici") == 1
         assert T.counter_value("exchange_bytes_total",
-                               op="matrix_1q") == shard_bytes
+                               op="matrix_1q", tier="ici") == shard_bytes
 
     def test_swap_records_half_shard(self, env):
         n = 6
@@ -409,7 +452,7 @@ class TestExchangeAccounting:
         shard_bytes = 2 * (1 << (n - dist.num_shard_bits(env.mesh))) \
             * amps.dtype.itemsize
         assert T.counter_value("exchange_bytes_total",
-                               op="swap") == shard_bytes // 2
+                               op="swap", tier="ici") == shard_bytes // 2
 
     def test_no_double_count_inside_user_jit(self, env):
         """A wrapper reached while TRACING a user jit must not record —

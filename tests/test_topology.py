@@ -1,0 +1,427 @@
+"""Hierarchical DCN x ICI topology layer (parallel/topology.py, ISSUE 12).
+
+Covers the acceptance contract:
+  * the pure topology model: spec parsing, resolution against the live
+    device count (with the load-bearing silent fallback), tier
+    classification of mesh bits / XOR masks / collective pairs, host
+    arithmetic, degraded-mesh shrinking, and the planner/weight knobs;
+  * HLO-pinned collective PLACEMENT on the emulated 2x4 arrangement:
+    exact per-tier collective-permute counts via ``introspect.audit``'s
+    ``tier_counts`` under a ``CollectiveBudget`` — single-mesh-bit
+    exchanges on chip bits ride ICI only, host-bit exchanges ride DCN;
+  * flat-vs-hierarchical planner bit-identity: ``QT_TOPOLOGY_PLANNER``
+    changes WHERE bytes move, never what is computed;
+  * predicted-vs-measured per-tier reconciliation: a clean drain on the
+    emulated 2x4 topology ends with ``model_drift_total == 0`` and
+    tier-exact predicted byte series;
+  * the operator surface: ``getEnvironmentString``'s ``Topology=`` line
+    and ``reportPerf``'s per-tier byte section.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu import env as E
+from quest_tpu import introspect
+from quest_tpu import telemetry as T
+from quest_tpu.introspect import CollectiveBudget
+from quest_tpu.parallel import dist
+from quest_tpu.parallel import topology as TOPO
+
+H_SOA = np.stack([(1 / np.sqrt(2)) * np.array([[1.0, 1], [1, -1]]),
+                  np.zeros((2, 2))])
+
+
+def _u4(seed=3):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    u, _ = np.linalg.qr(g)
+    return u
+
+
+# ---------------------------------------------------------------------------
+# The pure model (no jax, no mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestModel:
+    def test_parse_spec(self):
+        assert TOPO.parse_spec("2x4") == (2, 4)
+        assert TOPO.parse_spec(" 4X2 ") == (4, 2)
+        assert TOPO.parse_spec("2×4") == (2, 4)  # unicode ×
+        for bad in (None, "", "8", "2x4x2", "ax4", "0x8", "-2x4"):
+            assert TOPO.parse_spec(bad) is None
+
+    def test_resolve_exact_factoring(self, monkeypatch):
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        t = TOPO.resolve(8)
+        assert (t.hosts, t.chips) == (2, 4)
+        assert t.ici_bits == 2 and t.dcn_bits == 1
+        assert t.num_devices == 8
+        assert t.describe() == "2x4 (ici=2, dcn=1)"
+
+    def test_resolve_fallback_single_host(self, monkeypatch):
+        """A spec that does not factor the live mesh is silently ignored
+        — the survivors of a failover keep classifying consistently
+        while the env var still says the old shape."""
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        t = TOPO.resolve(4)  # 2*4 != 4
+        assert (t.hosts, t.chips) == (1, 4)
+        assert t.dcn_bits == 0
+        # and non-pow2 specs fall back too
+        assert TOPO.resolve(8, "3x3") == TOPO.Topology(1, 8)
+
+    def test_resolve_default_is_flat(self, monkeypatch):
+        monkeypatch.delenv(TOPO.TOPOLOGY_ENV, raising=False)
+        t = TOPO.resolve(8)
+        assert (t.hosts, t.chips) == (1, 8)
+        assert all(t.tier_of_bit(b) == "ici" for b in range(3))
+
+    def test_tier_classification(self):
+        t = TOPO.Topology(2, 4)
+        assert [t.tier_of_bit(b) for b in range(3)] == ["ici", "ici", "dcn"]
+        assert t.tier_of_mask(0b011) == "ici"
+        assert t.tier_of_mask(0b100) == "dcn"
+        assert t.tier_of_mask(0b101) == "dcn"  # any host bit -> DCN
+        assert t.tier_of_pair(0, 3) == "ici"   # same host
+        assert t.tier_of_pair(0, 4) == "dcn"   # host 0 <-> host 1
+        assert t.tier_of_pair(5, 1) == "dcn"
+
+    def test_host_arithmetic(self):
+        t = TOPO.Topology(2, 4)
+        assert [t.host_of(s) for s in range(8)] == [0] * 4 + [1] * 4
+        assert list(t.host_range(1)) == [4, 5, 6, 7]
+
+    def test_invalid_topology_rejected(self):
+        with pytest.raises(ValueError):
+            TOPO.Topology(3, 4)
+
+    def test_shrink(self):
+        t = TOPO.Topology(2, 4)
+        s = TOPO.shrink(t, 4)      # host loss: 2x4 -> 1x4
+        assert (s.hosts, s.chips) == (1, 4)
+        s = TOPO.shrink(t, 2)      # sub-host shrink: collapse
+        assert (s.hosts, s.chips) == (1, 2)
+        assert TOPO.shrink(None, 8).hosts == 1
+        s = TOPO.shrink(TOPO.Topology(4, 2), 4)
+        assert (s.hosts, s.chips) == (2, 2)
+
+    def test_split_pair_list(self):
+        pairs = [(0, 1), (1, 0), (0, 4), (2, 2), (6, 7)]
+        assert TOPO.split_pair_list(pairs, 4) == {"ici": 3, "dcn": 1}
+        # chips=8 (flat): nothing crosses a host
+        assert TOPO.split_pair_list(pairs, 8) == {"ici": 4, "dcn": 0}
+
+    def test_planner_mode_and_weights(self, monkeypatch):
+        monkeypatch.delenv(TOPO.PLANNER_ENV, raising=False)
+        assert TOPO.planner_mode() == "hier"
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "flat")
+        assert TOPO.planner_mode() == "flat"
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "anything-else")
+        assert TOPO.planner_mode() == "hier"
+
+        monkeypatch.delenv(TOPO.WEIGHT_DCN_ENV, raising=False)
+        assert TOPO.tier_weights() == TOPO.DEFAULT_TIER_WEIGHTS
+        monkeypatch.setenv(TOPO.WEIGHT_DCN_ENV, "16")
+        assert TOPO.tier_weights()["dcn"] == 16.0
+        monkeypatch.setenv(TOPO.WEIGHT_DCN_ENV, "junk")
+        assert TOPO.tier_weights()["dcn"] == \
+            TOPO.DEFAULT_TIER_WEIGHTS["dcn"]
+
+    def test_signature_tracks_knobs(self, monkeypatch):
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        a = TOPO.signature(8)
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "flat")
+        b = TOPO.signature(8)
+        monkeypatch.delenv(TOPO.PLANNER_ENV, raising=False)
+        monkeypatch.setenv(TOPO.WEIGHT_DCN_ENV, "32")
+        c = TOPO.signature(8)
+        assert len({a, b, c}) == 3  # each knob splits the plan cache
+
+    def test_hierarchical_enabled(self, monkeypatch):
+        monkeypatch.delenv(TOPO.PLANNER_ENV, raising=False)
+        assert TOPO.hierarchical_enabled(TOPO.Topology(2, 4))
+        assert not TOPO.hierarchical_enabled(TOPO.Topology(1, 8))
+        assert not TOPO.hierarchical_enabled(None)
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "flat")
+        assert not TOPO.hierarchical_enabled(TOPO.Topology(2, 4))
+
+
+# ---------------------------------------------------------------------------
+# Tier-aware cost model consistency
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_remap_tiers_sum_to_flat_model(self):
+        """The per-tier split of any remap is EXACT: tier bytes sum to
+        remap_exchange_bytes, tier counts to remap_exchange_count."""
+        n, r = 6, 3
+        nloc = n - r
+        t24 = TOPO.Topology(2, 4)
+        perms = [
+            (n - 1,) + tuple(range(1, n - 1)) + (0,),   # mixed 0<->5
+            (3, 1, 2, 0, 4, 5),                          # mixed 0<->3
+            (0, 1, 2, 4, 3, 5),                          # mesh tau 3<->4
+            (5, 4, 2, 3, 1, 0),                          # mixed + tau
+        ]
+        for perm in perms:
+            sigma = dist.canonical_sigma(perm)
+            tiers = dist.remap_exchange_tiers(sigma, nloc, r, 16, t24)
+            assert sum(b for _c, b in tiers.values()) == \
+                CIRC.remap_exchange_bytes(sigma, n, nloc, 16)
+            assert sum(c for c, _b in tiers.values()) == \
+                dist.remap_exchange_count(sigma, nloc, r)
+
+    def test_remap_tier_placement(self):
+        n, r = 6, 3
+        nloc = n - r
+        t24 = TOPO.Topology(2, 4)
+        # local bit 0 <-> mesh bit 0 (qubit 3): intra-host half-shard
+        sigma = dist.canonical_sigma((3, 1, 2, 0, 4, 5))
+        tiers = dist.remap_exchange_tiers(sigma, nloc, r, 16, t24)
+        assert tiers.get("dcn", (0, 0)) == (0, 0)
+        assert tiers["ici"][0] == 1
+        # local bit 0 <-> mesh bit 2 (qubit 5): crosses the host boundary
+        sigma = dist.canonical_sigma(
+            (n - 1,) + tuple(range(1, n - 1)) + (0,))
+        tiers = dist.remap_exchange_tiers(sigma, nloc, r, 16, t24)
+        assert tiers.get("ici", (0, 0)) == (0, 0)
+        assert tiers["dcn"][0] == 1
+
+    def test_circuit_tier_bytes_wrapper(self):
+        n, nloc = 6, 3
+        sigma = dist.canonical_sigma((3, 1, 2, 0, 4, 5))
+        out = CIRC.remap_exchange_bytes_tiers(sigma, n, nloc, 16,
+                                              TOPO.Topology(2, 4))
+        assert set(out) <= {"ici", "dcn"}
+        assert sum(out.values()) == \
+            CIRC.remap_exchange_bytes(sigma, n, nloc, 16)
+
+    def test_planner_parks_evictees_on_dcn(self, monkeypatch):
+        """The tier-aware planner's observable choice: when a window
+        needs qubits resident on both tiers, the DCN slot receives the
+        COLDEST evictee (flat planning follows request order instead)."""
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        n, nloc = 6, 3
+        perm = tuple(range(n))  # qubits 3,4,5 on mesh bits 0,1,2
+        # next window wants 3 (ici bit 0) and 5 (dcn bit 2) local; of
+        # the current locals, 0 is hottest and 2 coldest
+        next_use = {3: 1, 5: 2, 0: 3, 1: 4, 2: 5}
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "hier")
+        sig_h, perm_h = dist.plan_window_remap(n, nloc, perm, (3, 5),
+                                               next_use)
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "flat")
+        sig_f, perm_f = dist.plan_window_remap(n, nloc, perm, (3, 5),
+                                               next_use)
+        assert sig_h is not None and sig_f is not None
+
+        def parked_on_dcn(new_perm):
+            # which qubit ends on mesh bit 2 (global position 5)
+            return list(new_perm).index(5)
+
+        assert parked_on_dcn(perm_h) == 2   # coldest local -> DCN slot
+        assert parked_on_dcn(perm_f) == 1   # flat request order parks 1
+        # same work either way: identical hop count and byte volume
+        assert dist.remap_exchange_count(sig_h, nloc, 3) == \
+            dist.remap_exchange_count(sig_f, nloc, 3)
+        assert CIRC.remap_exchange_bytes(sig_h, n, nloc, 16) == \
+            CIRC.remap_exchange_bytes(sig_f, n, nloc, 16)
+
+
+# ---------------------------------------------------------------------------
+# HLO placement pins on the emulated 2x4 arrangement
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mesh8(env):
+    if env.num_devices < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    dist.use_explicit_dist(True)
+    dist.use_lazy_remap(True)
+    return env
+
+
+class TestHloPlacement:
+    """Exact per-tier collective counts in compiled programs, reading
+    the 8 shards as 2 hosts x 4 chips.  The classification is pure
+    arithmetic over each instruction's ``source_target_pairs`` — no env
+    var needed at compile time."""
+
+    CHIPS = 4
+
+    def test_chip_bit_exchange_rides_ici(self, mesh8):
+        n = 6
+        amps = qt.createQureg(n, mesh8).amps
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            report = introspect.audit(
+                lambda a: dist.apply_matrix_1q_sharded(
+                    a, H_SOA.reshape(2, 2, 2), mesh=mesh8.mesh,
+                    num_qubits=n, target=3, chunks=1),  # mesh bit 0
+                amps, donate=True)
+        assert report.tier_counts(self.CHIPS) == {"ici": 1, "dcn": 0}
+
+    def test_host_bit_exchange_rides_dcn(self, mesh8):
+        n = 6
+        amps = qt.createQureg(n, mesh8).amps
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            report = introspect.audit(
+                lambda a: dist.apply_matrix_1q_sharded(
+                    a, H_SOA.reshape(2, 2, 2), mesh=mesh8.mesh,
+                    num_qubits=n, target=n - 1, chunks=1),  # mesh bit 2
+                amps, donate=True)
+        assert report.tier_counts(self.CHIPS) == {"ici": 0, "dcn": 1}
+
+    def test_mesh_tau_within_hosts_rides_ici(self, mesh8):
+        """A shard-index permutation moving only chip bits (mesh 0<->1)
+        never leaves the host."""
+        n = 6
+        amps = qt.createQureg(n, mesh8).amps
+        sigma = dist.canonical_sigma((0, 1, 2, 4, 3, 5))
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            report = introspect.audit(
+                lambda a: dist.remap_sharded(
+                    a, mesh=mesh8.mesh, num_qubits=n, sigma=sigma,
+                    chunks=(1, 1)),
+                amps, donate=True)
+        assert report.tier_counts(self.CHIPS) == {"ici": 1, "dcn": 0}
+
+    def test_mixed_remap_to_host_bit_rides_dcn(self, mesh8):
+        n = 6
+        amps = qt.createQureg(n, mesh8).amps
+        sigma = dist.canonical_sigma(
+            (n - 1,) + tuple(range(1, n - 1)) + (0,))
+        with CollectiveBudget(exact={"collective-permute": 1}):
+            report = introspect.audit(
+                lambda a: dist.remap_sharded(
+                    a, mesh=mesh8.mesh, num_qubits=n, sigma=sigma,
+                    chunks=(1, 1)),
+                amps, donate=True)
+        assert report.tier_counts(self.CHIPS) == {"ici": 0, "dcn": 1}
+
+    def test_flat_reading_sees_no_dcn(self, mesh8):
+        """The same compiled program read as 1x8 (chips=8) classifies
+        everything ICI — the tier split is a VIEW of the routing table,
+        not a recompilation."""
+        n = 6
+        amps = qt.createQureg(n, mesh8).amps
+        report = introspect.audit(
+            lambda a: dist.apply_matrix_1q_sharded(
+                a, H_SOA.reshape(2, 2, 2), mesh=mesh8.mesh,
+                num_qubits=n, target=n - 1, chunks=1),
+            amps, donate=True)
+        counts = report.tier_counts(8)
+        assert counts["dcn"] == 0 and counts["ici"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Planner A/B bit-identity + per-tier reconciliation
+# ---------------------------------------------------------------------------
+
+
+def _churn_drain(env, n=6, seed=3):
+    """A fused circuit whose windows force remaps across both tiers."""
+    u = _u4(seed)
+    q = qt.createQureg(n, env)
+    with qt.gateFusion(q):
+        for a, b in [(0, 1), (n - 2, n - 1), (0, n - 1), (1, 2)]:
+            qt.multiQubitUnitary(q, [a, b], u)
+    return np.asarray(q.amps)
+
+
+class TestPlannerEquivalence:
+    def test_flat_vs_hier_bit_identical(self, mesh8, monkeypatch):
+        """Acceptance: topology only changes WHERE bytes move.  The same
+        circuit drained under the flat and the hierarchical planner
+        yields bitwise-identical amplitudes."""
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "flat")
+        flat = _churn_drain(mesh8)
+        monkeypatch.setenv(TOPO.PLANNER_ENV, "hier")
+        hier = _churn_drain(mesh8)
+        assert np.array_equal(flat, hier)
+        # and both agree with the untopologized baseline
+        monkeypatch.delenv(TOPO.TOPOLOGY_ENV)
+        assert np.array_equal(flat, _churn_drain(mesh8))
+
+    def test_clean_drain_reconciles_per_tier(self, mesh8, monkeypatch):
+        """Acceptance: a clean 2x4 drain ends with zero model drift and
+        the predicted per-tier byte series matching the measured ones
+        exactly."""
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        prev = T.mode_name()
+        T.configure("on")
+        try:
+            T.reset()
+            _churn_drain(mesh8)
+            assert T.counter_total("model_drift_total") == 0
+            for tier in TOPO.TIERS:
+                assert T.counter_sum(
+                    "predicted_exchange_bytes_total",
+                    op="window_remap", tier=tier) == \
+                    T.counter_sum("exchange_bytes_total",
+                                  op="window_remap", tier=tier)
+            # something actually crossed the emulated host boundary
+            assert T.counter_sum("exchange_bytes_total", tier="dcn") > 0
+        finally:
+            T.reset()
+            T.configure(prev)
+
+    def test_explain_reports_tier_totals(self, mesh8, monkeypatch):
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        n = 6
+        u = _u4()
+        q = qt.createQureg(n, mesh8)
+        qt.startGateFusion(q)
+        for a, b in [(0, 1), (n - 2, n - 1)]:
+            qt.multiQubitUnitary(q, [a, b], u)
+        report = qt.explainCircuit(q)
+        t = report["totals"]
+        assert t["topology"] == "2x4 (ici=2, dcn=1)"
+        assert sum(t["tier_bytes"].values()) == t["exchange_bytes"]
+        w = TOPO.tier_weights()
+        assert t["weighted_exchange_cost"] == pytest.approx(
+            sum(w[k] * v for k, v in t["tier_bytes"].items()))
+        assert "tier bytes:" in report.table()
+
+
+# ---------------------------------------------------------------------------
+# Operator surface
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorSurface:
+    def test_environment_string_topology_line(self, env, monkeypatch):
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        e = qt.createQuESTEnv()
+        if e.num_devices < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        assert e.topology is not None
+        assert "Topology=2x4 (ici=2, dcn=1)" in qt.getEnvironmentString(e)
+
+    def test_report_perf_tier_section(self, env, capsys):
+        prev = T.mode_name()
+        T.configure("on")
+        try:
+            T.reset()
+            T.record_exchange("unit", 1, 512, chunks=1, tier="ici")
+            T.record_exchange("unit", 1, 256, chunks=1, tier="dcn")
+            qt.reportPerf(env)
+            out = capsys.readouterr().out
+            assert "exchange tiers" in out
+            assert "ici" in out and "dcn" in out
+        finally:
+            T.reset()
+            T.configure(prev)
+
+    def test_shrunk_env_keeps_chips(self, monkeypatch):
+        monkeypatch.setenv(TOPO.TOPOLOGY_ENV, "2x4")
+        e = qt.createQuESTEnv()
+        if e.num_devices < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        small = E.shrink_env(e, 4, exclude_indices=list(range(4, 8)))
+        assert (small.topology.hosts, small.topology.chips) == (1, 4)
+        assert small.num_devices == 4
