@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-regress bench docs clean
 
 all: native
 
@@ -43,9 +43,19 @@ verify-serve:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_serve.py
 
+# Circuit optimizer (docs/design.md §26): the pre-planner rewrite
+# contract suite (parity on every path, bit-identical cancellation,
+# plan-key retrace, drift==0) plus the A/B guard — amplitude parity,
+# no exchange regression on any workload, >= 1.5x window-remap
+# exchange reduction on the config-6-style churn.  The headline
+# speedup joins the regression trajectory as bench_suite config 14.
+verify-optimizer:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_optimizer.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_optimizer.py
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static verify-serve
+verify: verify-static verify-serve verify-optimizer
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

@@ -693,9 +693,39 @@ def config13():
            "topology": rec["topology"]})
 
 
+def config14():
+    """Circuit-optimizer A/B (ISSUE 13): QT_OPTIMIZER=on vs off on a
+    config-2-style random circuit, a QFT-like phase-heavy ladder, and
+    the config-6-style remap churn (scripts/bench_optimizer.py).  The
+    timing line carries the headline wall-clock speedup plus per-workload
+    exchange reductions and the parity/drift checks."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_optimizer
+
+    t0 = time.perf_counter()
+    try:
+        rec = bench_optimizer.run(n=10 if CPU else 24,
+                                  depth=24 if CPU else 60)
+    except RuntimeError as e:
+        _emit(14, f"optimizer A/B (SKIPPED: {e})", 0.0, "speedup_x", 0.0)
+        return
+    _set_compile(0.0)  # both arms warm inside run()
+    w = rec["workloads"]
+    _emit(14, f"{rec['n']}q circuit-optimizer wall-clock speedup",
+          rec["optimizer_speedup_x"], "speedup_x",
+          round(time.perf_counter() - t0, 3),
+          {name: {"speedup_x": r["speedup_x"],
+                  "exchange_reduction_x": r["exchange_reduction_x"],
+                  "gates": f"{r['on']['gates_in']}->{r['on']['gates_out']}",
+                  "max_abs_err": r["max_abs_err"],
+                  "drift": r["on"]["drift"] + r["off"]["drift"]}
+           for name, r in w.items()})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
+           11: config11, 12: config12, 13: config13, 14: config14}
 
 
 def main():

@@ -81,6 +81,11 @@ from . import telemetry
 from .telemetry import report_perf as reportPerf, report_perf
 from . import governor
 from .governor import MemoryAdmissionError
+from . import optimizer
+from .optimizer import (
+    set_circuit_optimizer,
+    get_circuit_optimizer,
+)
 from . import introspect
 from .introspect import (
     explain_circuit,

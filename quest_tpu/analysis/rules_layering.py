@@ -10,8 +10,8 @@ imports:
     serve (serve)                              rank 1
       ↓
     orchestration (fusion, batch, circuit,     rank 2
-      resilience, checkpoint, introspect,
-      governor)
+      optimizer, resilience, checkpoint,
+      introspect, governor)
       ↓
     dist (parallel/*)                          rank 3
       ↓
@@ -63,8 +63,8 @@ LAYER_OF = {
     "api": "api", "api_ops": "api", "debug": "api", "models": "api",
     "serve": "serve",
     "fusion": "orch", "batch": "orch", "circuit": "orch",
-    "resilience": "orch", "checkpoint": "orch", "introspect": "orch",
-    "governor": "orch",
+    "optimizer": "orch", "resilience": "orch", "checkpoint": "orch",
+    "introspect": "orch", "governor": "orch",
     "parallel": "dist",
     "ops": "ops",
     "env": "env",

@@ -1147,6 +1147,31 @@ def _qft_qasm_trail(qureg: Qureg, qubits, nt: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Circuit optimizer knob (optimizer.py, docs/design.md §26)
+# ---------------------------------------------------------------------------
+
+
+def setCircuitOptimizer(mode: Optional[str]) -> None:
+    """Select the circuit-optimizer mode for subsequent fusion drains:
+    ``"off"``, ``"on"`` (cancellation/merging, diagonal coalescing, and
+    greedy cost-guided reordering), or ``"aggressive"`` (wider reorder
+    search + near-identity drops).  ``None`` returns control to the
+    ``QT_OPTIMIZER`` env var.  The mode is part of the fusion plan-cache
+    key and the batch structure fingerprint, so flipping it retraces
+    rather than replaying a stale plan."""
+    from . import optimizer as _optimizer
+
+    _optimizer.set_circuit_optimizer(mode)
+
+
+def getCircuitOptimizer() -> str:
+    """The active circuit-optimizer mode string."""
+    from . import optimizer as _optimizer
+
+    return _optimizer.get_circuit_optimizer()
+
+
+# ---------------------------------------------------------------------------
 # QASM recording (QuEST.h:3351-3390)
 # ---------------------------------------------------------------------------
 

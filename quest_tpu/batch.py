@@ -425,8 +425,13 @@ def _structure_fingerprint(gates: Sequence, num_qubits: int,
                            is_density: bool) -> tuple:
     """Hashable circuit STRUCTURE (targets + matrix shapes, not values):
     submissions with equal fingerprints plan to the same program skeleton
-    and may share a batch bucket."""
-    parts = [("q", int(num_qubits), bool(is_density))]
+    and may share a batch bucket.  The circuit-optimizer mode is part of
+    the fingerprint — the optimizer rewrites the bank's shared item list
+    before planning, so streams bucketed under different QT_OPTIMIZER
+    modes must never share a batch."""
+    from . import optimizer as _optimizer
+
+    parts = [("q", int(num_qubits), bool(is_density), _optimizer.mode())]
     for g in gates:
         m = np.asarray(g.mat)
         parts.append((tuple(g.targets), m.shape[-1]))

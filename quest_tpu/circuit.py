@@ -378,6 +378,24 @@ def rewrite_controlled_gates(glist: List[Gate]) -> List[Gate]:
     return out
 
 
+def is_identity_gate(mat_soa) -> bool:
+    """Concrete and EXACTLY the identity, bitwise — the circuit
+    optimizer's cancellation gate (optimizer.py): only a pair whose
+    product hits exact 1.0/0.0 entries (X·X, CNOT·CNOT, SWAP·SWAP, any
+    permutation pair) may be dropped without perturbing the drained
+    state; a merely-near-identity product (H·H is ``1+2e-16`` on the
+    f64 diagonal) must merge instead.  Accepts (2, s, s) and batched
+    (B, 2, s, s) stacks (all elements must be the identity)."""
+    if isinstance(mat_soa, jax.core.Tracer):
+        return False
+    m = np.asarray(mat_soa)
+    if m.dtype == object or m.ndim not in (3, 4):
+        return False
+    eye = np.eye(m.shape[-1], dtype=m.dtype)
+    return bool((m[..., 0, :, :] == eye).all()
+                and (m[..., 1, :, :] == 0.0).all())
+
+
 def is_diag_gate(mat_soa) -> bool:
     """Concrete and diagonal (any size) — such gates commute with a pass's
     diagonal mask and may keep folding after it."""

@@ -357,6 +357,11 @@ def get_environment_string(env: QuESTEnv) -> str:
         s += (f" MemGovernor={governor.policy()}"
               f"(budget={governor.budget_bytes()}"
               f" resident={governor.resident_bytes()})")
+    # circuit-optimizer surface (optimizer.py): active mode plus
+    # cumulative rewrite work when any has been recorded
+    from . import optimizer
+
+    s += f" {optimizer.summary_line()}"
     spills = telemetry.counter_total("spills_total")
     if spills:
         s += f" Spills={int(spills)}"

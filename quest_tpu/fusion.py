@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import circuit as C
+from . import optimizer as _opt
 from . import telemetry as _telemetry
 from .ops import cplx as _cplx
 
@@ -131,7 +132,10 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None, nsh: int = 0):
     windows/remaps under a different starting perm — and the topology
     signature (parallel/topology.py): the tier-aware window planner
     parks evictees differently per arrangement, so a QT_TOPOLOGY /
-    planner-mode flip must not reuse a stale plan."""
+    planner-mode flip must not reuse a stale plan.  The circuit-optimizer
+    mode is part of the key for the same reason: flipping QT_OPTIMIZER
+    rewrites the stream, so it must retrace rather than replay a plan
+    built under the other mode."""
     parts = []
     for it in items:
         if isinstance(it, ChannelItem):
@@ -147,7 +151,7 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None, nsh: int = 0):
         topo_sig = _topo.signature(1 << nsh)
     else:
         topo_sig = None
-    return (nloc, sweep_ok, perm0, topo_sig, tuple(parts))
+    return (nloc, sweep_ok, perm0, topo_sig, _opt.mode(), tuple(parts))
 
 
 def _split_items(items, nloc: int, sweep_ok: bool):
@@ -282,13 +286,22 @@ def _run(qureg, items) -> None:
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
+    perm0 = qureg._perm if nsh else None
+    # circuit-optimizer rewrite (optimizer.py): the plan-cache key, the
+    # planners, the governor predictor, and the §21 reconciliation below
+    # all see the OPTIMIZED stream — predictions are priced on what is
+    # actually drained, so model drift stays 0 by construction
+    with _telemetry.span("fusion.optimize", items=len(items)):
+        items, _ostats = _opt.optimize_items(
+            items, n=n, nloc=nloc, nsh=nsh, perm0=perm0)
+    if not items:
+        return  # everything cancelled: nothing to execute, perm unchanged
     bsz = int(getattr(qureg, "batch_size", 0) or 0)
     mats_batched = bool(bsz) and any(
         not isinstance(it, ChannelItem) and getattr(it.mat, "ndim", 0) == 4
         for it in items)
     from .ops import fused as _fusedmod
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
-    perm0 = qureg._perm if nsh else None
     key = _plan_key(items, nloc, sweep_ok, perm0, nsh)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:
@@ -491,15 +504,21 @@ def plan_items_quiet(qureg, items):
     n = qureg.num_qubits_in_state_vec
     nsh = _shard_bits(qureg)
     nloc = n - nsh
+    perm0 = qureg._perm if nsh else None
+    if not items:
+        return (), (), None, nloc, nsh
+    # the same optimizer rewrite _run applies, quietly — a dry run must
+    # predict the stream that would actually drain
+    items, _ostats = _opt.optimize_items(
+        items, n=n, nloc=nloc, nsh=nsh, perm0=perm0, quiet=True)
+    if not items:
+        return (), (), None, nloc, nsh
     bsz = int(getattr(qureg, "batch_size", 0) or 0)
     mats_batched = bool(bsz) and any(
         not isinstance(it, ChannelItem) and getattr(it.mat, "ndim", 0) == 4
         for it in items)
     from .ops import fused as _fusedmod
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
-    perm0 = qureg._perm if nsh else None
-    if not items:
-        return (), (), None, nloc, nsh
     key = _plan_key(items, nloc, sweep_ok, perm0, nsh)
     hit = _plan_cache.get(key) if key is not None else None
     if hit is not None:

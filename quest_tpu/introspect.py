@@ -140,6 +140,73 @@ def _sigma_cost(sigma, n: int, nloc: int, nsh: int, itemsize: int,
     }
 
 
+def _optimizer_section(orig_items, opt_items, ostats, *, n, nloc, nsh,
+                       perm0, itemsize, bw) -> dict:
+    """The explain report's ``optimizer`` entry: the rewrite's own stats
+    plus projected exchange savings — the SAME per-tier cost model the
+    window accounting uses, diffed between the original and the
+    optimized stream (sharded registers; scalar registers diff the local
+    planner's pass count instead)."""
+    from . import fusion as F
+    from .parallel import dist as PAR
+
+    section = {
+        "mode": ostats["mode"],
+        "gates_in": int(ostats["gates_in"]),
+        "gates_out": int(ostats["gates_out"]),
+        "removed": {k: int(v) for k, v in ostats["removed"].items()},
+        "reordered": bool(ostats["reordered"]),
+        "windows_before": ostats["windows_before"],
+        "windows_after": ostats["windows_after"],
+        "tier_savings_bytes": None,
+        "exchange_savings": None,
+    }
+    changed = (ostats["reordered"]
+               or any(ostats["removed"].values())
+               or len(opt_items) != len(orig_items))
+    if nsh and orig_items:
+
+        def _cost(seq):
+            tiers = {"ici": 0, "dcn": 0}
+            count = 0
+            if not seq:
+                return tiers, count
+            segments, fperm = C.plan_remap_windows(
+                [F._item_bits(it) for it in seq], n, nloc, perm0)
+            sigmas = [s for _ij, s, _p in segments if s is not None]
+            if fperm is not None and list(fperm) != list(range(n)):
+                sigmas.append(PAR.canonical_sigma(tuple(fperm)))
+            for sigma in sigmas:
+                count += PAR.remap_exchange_count(tuple(sigma), nloc, nsh)
+                for t, b in C.remap_exchange_bytes_tiers(
+                        tuple(sigma), n, nloc, itemsize).items():
+                    tiers[t] = tiers.get(t, 0) + b
+            return tiers, count
+
+        t0, c0 = _cost(orig_items)
+        t1, c1 = (t0, c0) if not changed else _cost(opt_items)
+        section["tier_savings_bytes"] = {
+            t: int((t0.get(t, 0) - t1.get(t, 0)) * bw) for t in t0}
+        section["exchange_savings"] = int((c0 - c1) * bw)
+    elif not nsh:
+        # scalar registers have no exchange cost; the comparable
+        # quantity is the local planner's HBM pass count (bounded:
+        # a dry re-plan of very long streams is not worth the host time)
+        gates0 = [it for it in orig_items if isinstance(it, C.Gate)]
+        if 0 < len(gates0) <= 512 and all(
+                isinstance(g.mat, np.ndarray) and g.mat.ndim == 3
+                for g in gates0):
+            gates1 = [it for it in opt_items if isinstance(it, C.Gate)]
+            wb = C.stats(C.plan_circuit(gates0, nloc))["total_passes"]
+            wa = C.stats(C.plan_circuit(gates1, nloc))["total_passes"] \
+                if gates1 else 0
+            if not changed:
+                wa = wb
+            section["windows_before"] = int(wb)
+            section["windows_after"] = int(wa)
+    return section
+
+
 def explain_circuit(qureg, gates=None) -> ExplainReport:
     """Dry-run the fusion planner over ``gates`` (or the register's
     pending fusion buffer when None) — NO device execution, no drain,
@@ -152,8 +219,16 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     exactly, and :func:`reconcile_drain` asserts exactly that after
     every sharded drain.  ``final_remap`` is the extra canonical-order
     rematerialization (``op=remap``) the next ``Qureg.amps`` read pays
-    when the plan leaves a live permutation behind."""
+    when the plan leaves a live permutation behind.
+
+    The circuit optimizer (optimizer.py, docs/design.md §26) rewrites
+    the stream before planning, so the whole report prices the
+    OPTIMIZED stream — exactly what a drain would execute — and the
+    ``optimizer`` section carries the rewrite's accounting: gates
+    in/out, removals by kind, remap windows before/after, and the
+    projected per-tier exchange savings from the same cost model."""
     from . import fusion as F
+    from . import optimizer as _optimizer
     from .ops import fused as _fusedmod
     from .parallel import topology as _topology
 
@@ -170,6 +245,17 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     itemsize = int(np.dtype(qureg.dtype).itemsize)
     sweep_ok = _fusedmod.channel_sweep_enabled(qureg.dtype)
     perm0 = qureg._perm if nsh else None
+
+    # the optimizer rewrite a drain would apply (quiet: no telemetry,
+    # no cache-status flips) — everything below prices opt_items; the
+    # memory section re-derives the same rewrite through
+    # plan_items_quiet, so both views describe one stream
+    orig_items = items
+    items, ostats = _optimizer.optimize_items(
+        items, n=n, nloc=nloc, nsh=nsh, perm0=perm0, quiet=True)
+    optimizer_section = _optimizer_section(
+        orig_items, items, ostats, n=n, nloc=nloc, nsh=nsh, perm0=perm0,
+        itemsize=itemsize, bw=bw)
 
     register = {
         "qubits": int(qureg.num_qubits_represented),
@@ -255,16 +341,19 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     # predicted per-device footprint of draining this stream — the
     # governor's analytic model (state x live-copy multiplier + pass
     # arrays, docs/design.md §22) over the EXACT program the drain
-    # would dispatch, planned quietly (no telemetry, no cache insert)
+    # would dispatch, planned quietly (no telemetry, no cache insert;
+    # plan_items_quiet re-applies the same optimizer rewrite, so the
+    # ORIGINAL stream goes in and is optimized exactly once)
     from . import governor as _gov
 
-    memory = _gov.explain_memory(qureg, items)
+    memory = _gov.explain_memory(qureg, orig_items)
     return ExplainReport(
         register=register,
         items=len(items),
         windows=windows,
         final_remap=final_remap,
         plan=plan,
+        optimizer=optimizer_section,
         memory=memory,
         totals={
             "windows": len(windows),
@@ -300,6 +389,21 @@ def format_explain(report: dict) -> str:
     head += (f", {report['items']} item(s), plan-cache={plan['cache']}, "
              f"chunks={plan['exchange_chunks_key']}")
     lines = [head]
+    opt = report.get("optimizer")
+    if opt:
+        rm = opt["removed"]
+        oline = (f"optimizer: mode={opt['mode']} "
+                 f"gates {opt['gates_in']}->{opt['gates_out']} "
+                 f"(cancel={rm['cancel']} merge={rm['merge']} "
+                 f"diag={rm['diag_coalesce']}"
+                 + (" reordered" if opt["reordered"] else "") + ")")
+        if opt["windows_before"] is not None:
+            oline += f" windows {opt['windows_before']}->{opt['windows_after']}"
+        ts = opt.get("tier_savings_bytes")
+        if ts is not None:
+            oline += (f" saves exch={opt['exchange_savings']} "
+                      f"bytes ici={ts['ici']} dcn={ts['dcn']}")
+        lines.append(oline)
     cols = f"{'window':>7} {'items':>6} {'gates':>6} {'chans':>6} " \
            f"{'exch':>5} {'bytes/shard':>12} {'chunks':>7}  sigma"
     lines.append(cols)

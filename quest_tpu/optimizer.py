@@ -1,0 +1,634 @@
+"""Cost-model-guided circuit optimizer: rewrite the pending gate stream
+BEFORE the fusion planner sees it (docs/design.md §26).
+
+The scheduler so far optimized *how* to execute a drain — window remaps
+(§14), pipelined exchange (§17), batched banks (§20) — but every gate in
+the buffer still reached ``fusion._split_items`` verbatim.  OptQC-style
+circuit optimization (PAPERS.md) closes that gap with three families of
+semantics-preserving transforms over the buffered item stream:
+
+* **Cancellation / merging** — a gate searches backwards through gates it
+  commutes with for a same-target partner; the pair composes via one host
+  matmul (``circuit.soa_matmul``).  A product that is EXACTLY the
+  identity (bitwise — X·X, CNOT·CNOT, SWAP·SWAP, Z·Z qualify; H·H does
+  not, its f64 product is ``1+2e-16`` on the diagonal) cancels outright;
+  anything else replaces the partner as one merged gate.  Exact-identity
+  gating keeps cancellation bit-identical to the unoptimized stream; the
+  near-identity drop (tolerance-scaled) is reserved for ``aggressive``.
+
+* **Diagonal / phase coalescing** — maximal runs of adjacent diagonal
+  gates (Z, S, T, phase shifts, controlled phases — anything
+  ``circuit.is_diag_gate`` accepts) collapse into ONE diagonal gate on
+  the union targets (capped at the fusion gate width), replacing a chain
+  of small matmul passes with a single phase-mask application.
+
+* **Commutation-aware reordering** (sharded registers) — a dependency
+  DAG over the stream (edges between non-commuting items; commutation =
+  disjoint supports, diagonal↔diagonal, or same-target matrices that
+  numerically commute) is greedily re-linearized to cluster items by
+  target-locality so ``circuit.plan_remap_windows`` emits fewer sigmas.
+  The candidate order is *scored against the scheduler's own cost
+  model* — ``dist.remap_exchange_count`` and the tier-weighted
+  ``circuit.remap_exchange_bytes_tiers`` under the live
+  logical→physical permutation — and adopted only when strictly
+  cheaper, so the optimizer minimizes actual ICI/DCN exchange, not gate
+  count alone.  ``aggressive`` widens the search to several candidate
+  linearizations.
+
+``QT_OPTIMIZER=off|on|aggressive`` (default ``on``) selects the mode;
+``set_circuit_optimizer`` overrides it programmatically.  The mode is
+part of the fusion plan-cache key AND the batch structure fingerprint,
+so flipping it retraces and never mixes buckets.  Because the rewrite
+happens before planning, every downstream consumer — the plan cache,
+the governor's drain predictor, telemetry's window accounting, and the
+§21 predicted-vs-measured reconciliation — prices the OPTIMIZED stream:
+``model_drift_total`` stays 0 on optimized drains by construction.
+
+Channels (``fusion.ChannelItem``) and traced (non-numpy) matrices are
+never composed or dropped; they participate in reordering only through
+the disjoint-support rule, so probability streams keep their relative
+order and value-dependent gates are left untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import circuit as C
+from . import telemetry as _telemetry
+
+_MODES = ("off", "on", "aggressive")
+
+# programmatic override (setCircuitOptimizer); None = read QT_OPTIMIZER
+_OVERRIDE: List[Optional[str]] = [None]
+
+# widest coalesced diagonal gate — mirrors fusion.FUSION_MAX_GATE_QUBITS
+# (not imported: fusion imports this module)
+MAX_GATE_QUBITS = 7
+
+# reordering is O(items^2) host work; past this the stream is left in
+# program order (cancellation/coalescing still run — they are O(k·depth))
+_REORDER_MAX_ITEMS = 512
+
+# memoized rewrites: optimizing is pure host work but a hot angle-sweep
+# loop re-drains the same stream thousands of times
+_CACHE_MAX = 128
+_cache: dict = {}
+
+# suppression depth (see suppressed()): >0 forces optimize_items into a
+# verbatim no-op regardless of mode
+_SUPPRESS: List[int] = [0]
+
+
+def mode() -> str:
+    """Active optimizer mode: the ``set_circuit_optimizer`` override when
+    armed, else ``QT_OPTIMIZER`` (default ``on``)."""
+    if _OVERRIDE[0] is not None:
+        return _OVERRIDE[0]
+    m = os.environ.get("QT_OPTIMIZER", "on").strip().lower()
+    return m if m in _MODES else "on"
+
+
+def set_circuit_optimizer(m: Optional[str]) -> None:
+    """Override the optimizer mode (``None`` returns control to the
+    ``QT_OPTIMIZER`` env var)."""
+    if m is not None:
+        m = str(m).strip().lower()
+        if m not in _MODES:
+            from .validation import QuESTError
+
+            raise QuESTError(
+                f"setCircuitOptimizer: unknown mode {m!r} "
+                f"(expected one of {'/'.join(_MODES)})")
+    _OVERRIDE[0] = m
+
+
+def get_circuit_optimizer() -> str:
+    """The active optimizer mode string."""
+    return mode()
+
+
+class suppressed:
+    """Context manager forcing :func:`optimize_items` into a verbatim
+    no-op for the drains it encloses.
+
+    Window-stepped execution (``resilience.WindowExecutor`` — the shared
+    core of ``run_resumable`` and the serving layer) drains one gate
+    window at a time through fusion, and its checkpoint cursor indexes
+    the RAW gate list; a resumed run may re-enter the stream on a
+    DIFFERENT mesh (elastic 8→4 failover, mesh-portable checkpoints) and
+    under a different live permutation.  The rewrite is cost-gated on
+    exactly those inputs, so letting it fire per window would make the
+    executed stream depend on mesh/perm history — breaking the
+    bit-identity-across-resume contracts that layer pins.  Those drains
+    run under ``suppressed()``; direct drains are unaffected."""
+
+    def __enter__(self):
+        _SUPPRESS[0] += 1
+        return self
+
+    def __exit__(self, *exc):
+        _SUPPRESS[0] -= 1
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Item predicates
+# ---------------------------------------------------------------------------
+
+
+def _is_gate(it) -> bool:
+    return isinstance(it, C.Gate)
+
+
+def _concrete(it) -> bool:
+    return _is_gate(it) and isinstance(it.mat, np.ndarray) \
+        and it.mat.ndim in (3, 4)
+
+
+def _bits(it) -> frozenset:
+    """Logical state-vector bits an item touches (fusion._item_bits as a
+    set; channels touch their ket + bra twin bits)."""
+    if _is_gate(it):
+        return frozenset(it.targets)
+    return frozenset((it.target, it.bra))
+
+
+def _soa_matmul_any(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Complex SoA matrix product for (2, s, s) and per-element
+    (B, 2, s, s) stacks, broadcasting a shared operand across a batched
+    one.  The 3-dim case delegates to circuit.soa_matmul so a merged
+    gate's matrix is bit-identical to the fold the window planner would
+    have computed for the same pair."""
+    if a.ndim == 3 and b.ndim == 3:
+        return C.soa_matmul(a, b)
+    ar, ai = a[..., 0, :, :], a[..., 1, :, :]
+    br, bi = b[..., 0, :, :], b[..., 1, :, :]
+    return np.stack([ar @ br - ai @ bi, ar @ bi + ai @ br], axis=-3)
+
+
+# exact-identity cancellation gate (see circuit.is_identity_gate: X·X
+# cancels bitwise, H·H must merge)
+_is_identity = C.is_identity_gate
+
+
+def _near_identity(m: np.ndarray) -> bool:
+    """Identity up to the dtype's diagonal-detection tolerance — the
+    ``aggressive``-mode drop for merged pairs like H·H whose product is
+    the identity only up to rounding."""
+    s = m.shape[-1]
+    eye = np.eye(s, dtype=m.dtype)
+    tol = 1e-5 if m.dtype == np.float32 else 1e-10
+    return bool(np.abs(m[..., 0, :, :] - eye).max() <= tol
+                and np.abs(m[..., 1, :, :]).max() <= tol)
+
+
+def _is_diag(it) -> bool:
+    return _concrete(it) and it.mat.ndim == 3 and C.is_diag_gate(it.mat)
+
+
+def _mats_commute(a: np.ndarray, b: np.ndarray) -> bool:
+    ab = _soa_matmul_any(a, b)
+    ba = _soa_matmul_any(b, a)
+    tol = 1e-5 if ab.dtype == np.float32 else 1e-10
+    return bool(np.abs(ab - ba).max() <= tol)
+
+
+def _commutes(a, b, diag_a: bool, diag_b: bool) -> bool:
+    """May items ``a`` and ``b`` swap order?  Disjoint supports always
+    commute; overlapping gates commute when both are diagonal (covers
+    Z/S/T/phase-shift/CZ/CPhase chains sharing controls or targets) or
+    when they act on the SAME targets with numerically commuting
+    matrices (same-axis rotation runs).  Channels only commute by
+    disjointness — their Kraus maps are diagonal-basis-specific."""
+    if not (_bits(a) & _bits(b)):
+        return True
+    if not (_is_gate(a) and _is_gate(b)):
+        return False
+    if diag_a and diag_b:
+        return True
+    if (tuple(a.targets) == tuple(b.targets) and _concrete(a)
+            and _concrete(b) and a.mat.ndim == 3 and b.mat.ndim == 3):
+        return _mats_commute(a.mat, b.mat)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: cancellation / merging
+# ---------------------------------------------------------------------------
+
+
+def _cancel_merge(items: list, removed: dict, aggressive: bool) -> list:
+    """One left-to-right pass: each concrete gate looks backwards through
+    items it commutes with for a same-target partner to compose with.
+    An exact-identity product cancels the pair; otherwise the partner is
+    replaced by the merged gate (matmul order: partner first, newcomer
+    second → ``new @ old``)."""
+    out: list = []
+    diag: list = []  # _is_diag per out entry, computed once
+
+    for it in items:
+        if not _concrete(it):
+            out.append(it)
+            diag.append(False)
+            continue
+        d_it = _is_diag(it)
+        j = len(out) - 1
+        composed = False
+        while j >= 0:
+            prev = out[j]
+            if (_concrete(prev)
+                    and tuple(prev.targets) == tuple(it.targets)):
+                merged = _soa_matmul_any(it.mat, prev.mat)
+                if _is_identity(merged) or (
+                        aggressive and _near_identity(merged)):
+                    out.pop(j)
+                    diag.pop(j)
+                    removed["cancel"] += 2
+                else:
+                    out[j] = C.Gate(prev.targets, merged)
+                    diag[j] = _is_diag(out[j])
+                    removed["merge"] += 1
+                composed = True
+                break
+            if _commutes(prev, it, diag[j], d_it):
+                j -= 1
+                continue
+            break
+        if not composed:
+            out.append(it)
+            diag.append(d_it)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: diagonal / phase coalescing
+# ---------------------------------------------------------------------------
+
+
+def _gate_diag(m: np.ndarray) -> np.ndarray:
+    """(2, s) diagonal of a stacked SoA matrix."""
+    idx = np.arange(m.shape[-1])
+    return m[:, idx, idx]
+
+
+def _compose_diag_run(run: Sequence[C.Gate]) -> C.Gate:
+    """Collapse a run of diagonal gates into ONE diagonal gate on the
+    sorted union of their targets: each gate's (2, 2^k) diagonal is
+    gathered up to the union index space and the entries multiply
+    complex-elementwise in stream order."""
+    union = sorted({t for g in run for t in g.targets})
+    upos = {t: i for i, t in enumerate(union)}
+    d = 1 << len(union)
+    idx = np.arange(d)
+    dt = np.result_type(*[g.mat.dtype for g in run])
+    re = np.ones(d, dtype=dt)
+    im = np.zeros(d, dtype=dt)
+    for g in run:
+        sub = np.zeros(d, dtype=np.int64)
+        for i, t in enumerate(g.targets):
+            sub |= ((idx >> upos[t]) & 1) << i
+        gd = _gate_diag(np.asarray(g.mat, dtype=dt))
+        gre, gim = gd[0][sub], gd[1][sub]
+        re, im = re * gre - im * gim, re * gim + im * gre
+    mat = np.zeros((2, d, d), dtype=dt)
+    mat[0][idx, idx] = re
+    mat[1][idx, idx] = im
+    return C.Gate(tuple(union), mat)
+
+
+def _coalesce_diag(items: list, removed: dict, nloc: int) -> list:
+    """Collapse maximal runs of ADJACENT diagonal gates (adjacency after
+    the cancel/merge and reorder passes) whose union target set fits one
+    fused gate."""
+    cap = min(MAX_GATE_QUBITS, nloc)
+    out: list = []
+    run: list = []
+    runbits: set = set()
+
+    def flush():
+        if len(run) >= 2:
+            out.append(_compose_diag_run(run))
+            removed["diag_coalesce"] += len(run) - 1
+        else:
+            out.extend(run)
+        run.clear()
+        runbits.clear()
+
+    for it in items:
+        if _is_diag(it):
+            b = set(it.targets)
+            if len(runbits | b) > cap:
+                flush()
+            run.append(it)
+            runbits |= b
+        else:
+            flush()
+            out.append(it)
+    flush()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: commutation-aware reordering (sharded registers)
+# ---------------------------------------------------------------------------
+
+
+def _stream_cost(items: Sequence, n: int, nloc: int, perm0) -> tuple:
+    """Cost-model score of draining ``items`` in this order from the
+    live permutation ``perm0``: (tier-weighted exchange bytes, exchange
+    count, remap windows) — the same quantities explain_circuit reports
+    and reconcile_drain verifies, plus the canonical-read remap the next
+    ``Qureg.amps`` pays, so clustering cannot win by deferring cost to
+    the read."""
+    from .parallel import dist as PAR
+    from .parallel import topology as _topo
+
+    nsh = n - nloc
+    weights = _topo.tier_weights()
+    segments, final_perm = C.plan_remap_windows(
+        [tuple(sorted(_bits(it))) for it in items], n, nloc, perm0)
+    sigmas = [s for _ij, s, _p in segments if s is not None]
+    if final_perm is not None and list(final_perm) != list(range(n)):
+        sigmas.append(PAR.canonical_sigma(tuple(final_perm)))
+    count = 0
+    weighted = 0.0
+    for sigma in sigmas:
+        count += PAR.remap_exchange_count(tuple(sigma), nloc, nsh)
+        for tier, b in C.remap_exchange_bytes_tiers(
+                tuple(sigma), n, nloc).items():
+            weighted += weights.get(tier, 1.0) * b
+    return (weighted, count, len(segments))
+
+
+def _greedy_order(items: Sequence, nloc: int, prefer_overlap: bool) -> list:
+    """Greedy DAG linearization clustering ready items by target
+    locality: schedule the ready item whose bits grow the current
+    window's qubit set least (``prefer_overlap`` breaks ties toward the
+    largest overlap instead of program order — the extra ``aggressive``
+    candidate)."""
+    k = len(items)
+    bits = [_bits(it) for it in items]
+    diag = [_is_diag(it) for it in items]
+    preds = [0] * k
+    succs: List[List[int]] = [[] for _ in range(k)]
+    for i in range(k):
+        for j in range(i + 1, k):
+            if not _commutes(items[i], items[j], diag[i], diag[j]):
+                preds[j] += 1
+                succs[i].append(j)
+    ready = [i for i in range(k) if preds[i] == 0]
+    order: list = []
+    window: set = set()
+    while ready:
+        best = None
+        for i in ready:
+            grow = len(bits[i] - window)
+            if len(window | bits[i]) > nloc:
+                grow = len(bits[i]) + nloc  # forces a fresh window
+            key = (grow, -len(bits[i] & window), i) if prefer_overlap \
+                else (grow, i)
+            if best is None or key < best[0]:
+                best = (key, i)
+        i = best[1]
+        if len(window | bits[i]) > nloc:
+            window = set()
+        window |= bits[i]
+        order.append(i)
+        ready.remove(i)
+        for j in succs[i]:
+            preds[j] -= 1
+            if preds[j] == 0:
+                ready.append(j)
+    return order
+
+
+def _reorder(items: list, n: int, nloc: int, perm0,
+             aggressive: bool) -> tuple:
+    """Try greedy locality-clustering linearizations of the commutation
+    DAG and keep the first that the cost model scores STRICTLY cheaper
+    than program order.  Returns (items, reordered, cost_before,
+    cost_after)."""
+    base = _stream_cost(items, n, nloc, perm0)
+    if len(items) < 3 or len(items) > _REORDER_MAX_ITEMS:
+        return items, False, base, base
+    variants = (False, True) if aggressive else (False,)
+    best_items, best_cost = items, base
+    for prefer_overlap in variants:
+        order = _greedy_order(items, nloc, prefer_overlap)
+        if order == list(range(len(items))):
+            continue
+        cand = [items[i] for i in order]
+        cost = _stream_cost(cand, n, nloc, perm0)
+        if cost < best_cost:
+            best_items, best_cost = cand, cost
+    return best_items, best_items is not items, base, best_cost
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def _freeze_out(items, out) -> tuple:
+    """Cache-storable form of a rewritten stream: channel items are
+    replaced by their INPUT index.  Channels key on (kind, target, bra)
+    — ``prob`` is a runtime value — so a cache hit must splice in the
+    CURRENT call's channel objects, not replay the first call's
+    probabilities."""
+    pos = {id(it): i for i, it in enumerate(items)}
+    return tuple(it if _is_gate(it) else ("__chan__", pos[id(it)])
+                 for it in out)
+
+
+def _thaw_out(items, frozen) -> list:
+    return [items[e[1]]
+            if isinstance(e, tuple) and e and e[0] == "__chan__" else e
+            for e in frozen]
+
+
+def _content_key(items, n: int, nloc: int, nsh: int, perm0, m: str):
+    """Memoization key mirroring fusion._plan_key: item content bytes +
+    the planning context the transforms depend on (None when any matrix
+    is traced — such streams are skipped anyway)."""
+    parts = []
+    for it in items:
+        if _is_gate(it):
+            mat = it.mat
+            if not isinstance(mat, np.ndarray):
+                return None
+            parts.append((tuple(it.targets), mat.dtype.str, mat.shape,
+                          mat.tobytes()))
+        else:
+            parts.append(("chan", it.kind, it.target, it.bra))
+    if nsh:
+        from .parallel import topology as _topo
+
+        topo_sig = _topo.signature(1 << nsh)
+    else:
+        topo_sig = None
+    return (m, n, nloc, nsh, perm0, topo_sig, tuple(parts))
+
+
+def _rewrite(items: list, nloc: int, aggressive: bool,
+             coalesce: bool) -> tuple:
+    """cancel/merge (+ optional diagonal coalescing) to a small fixpoint
+    — the two passes feed each other (a coalesced diagonal may cancel
+    against its inverse).  Returns (items, removed)."""
+    removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0}
+    out = list(items)
+    for _ in range(3):
+        before = len(out)
+        out = _cancel_merge(out, removed, aggressive)
+        if coalesce:
+            out = _coalesce_diag(out, removed, nloc)
+        if len(out) == before:
+            break
+    return out, removed
+
+
+def _optimize(items: list, n: int, nloc: int, nsh: int, perm0,
+              m: str) -> tuple:
+    """The actual rewrite (cache miss path): returns (items, stats)."""
+    aggressive = m == "aggressive"
+    gates_in = sum(1 for it in items if _is_gate(it))
+
+    reordered = False
+    cost_before = cost_after = None
+    windows_before = windows_after = None
+    if not nsh:
+        # single-shard: no exchange cost to trade against — fewer gates
+        # is strictly better, so take the full rewrite unconditionally
+        out, removed = _rewrite(items, nloc, aggressive, True)
+    else:
+        # sharded: every transform is a CANDIDATE scored against the
+        # exchange cost model, original program order included — a
+        # rewrite that shrinks the gate count but widens targets (e.g.
+        # a union-diagonal spanning cold qubits) can force extra remap
+        # windows, and must lose to the cheaper stream
+        out, removed = _rewrite(items, nloc, aggressive, True)
+        try:
+            candidates = [(out, removed)]
+            if removed["diag_coalesce"]:
+                candidates.append(_rewrite(items, nloc, aggressive, False))
+            best = None
+            for cand, rem in candidates:
+                cand, reord, _pre, cost = _reorder(
+                    cand, n, nloc, perm0, aggressive)
+                ngates = sum(1 for it in cand if _is_gate(it))
+                key = (cost, ngates)
+                if best is None or key < best[0]:
+                    best = (key, cand, rem, reord, cost)
+            cost_before = _stream_cost(items, n, nloc, perm0)
+            orig_key = (cost_before, gates_in)
+            if best[0] < orig_key:
+                _k, out, removed, reordered, cost_after = best
+            else:  # nothing beat program order: keep the stream as-is
+                out = list(items)
+                removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0}
+                reordered = False
+                cost_after = cost_before
+            windows_before = int(cost_before[2])
+            windows_after = int(cost_after[2])
+        except ValueError:
+            # the stream is not plannable in the remap-window model
+            # (e.g. a directly-injected gate wider than the shard-local
+            # space — capture_unitary never buffers those); keep the
+            # rewrite, leave program order, skip the cost accounting
+            cost_before = cost_after = None
+            windows_before = windows_after = None
+
+    gates_out = sum(1 for it in out if _is_gate(it))
+    stats = {
+        "mode": m,
+        "gates_in": int(gates_in),
+        "gates_out": int(gates_out),
+        "removed": {k: int(v) for k, v in removed.items()},
+        "reordered": bool(reordered),
+        "windows_before": windows_before,
+        "windows_after": windows_after,
+        "weighted_cost_before":
+            None if cost_before is None else float(cost_before[0]),
+        "weighted_cost_after":
+            None if cost_after is None else float(cost_after[0]),
+        "exchanges_before":
+            None if cost_before is None else int(cost_before[1]),
+        "exchanges_after":
+            None if cost_after is None else int(cost_after[1]),
+    }
+    return out, stats
+
+
+def optimize_items(items: Sequence, *, n: int, nloc: int, nsh: int = 0,
+                   perm0=None, quiet: bool = False) -> Tuple[list, dict]:
+    """Rewrite a drain's item stream under the active mode; returns
+    (items, stats).  ``quiet`` suppresses telemetry (the explain /
+    governor dry-run contract — fusion.plan_items_quiet).  Streams with
+    any traced matrix are returned untouched: value transforms need
+    concrete entries, and a partial rewrite would desynchronize the
+    batched-bank skeleton contract."""
+    m = mode() if not _SUPPRESS[0] else "off"
+    items = list(items)
+    if (m == "off" or len(items) < 2
+            or any(_is_gate(it) and not isinstance(it.mat, np.ndarray)
+                   for it in items)):
+        ngates = sum(1 for it in items if _is_gate(it))
+        return items, {"mode": m, "gates_in": ngates, "gates_out": ngates,
+                       "removed": {"cancel": 0, "merge": 0,
+                                   "diag_coalesce": 0},
+                       "reordered": False, "windows_before": None,
+                       "windows_after": None,
+                       "weighted_cost_before": None,
+                       "weighted_cost_after": None,
+                       "exchanges_before": None, "exchanges_after": None}
+    key = _content_key(items, n, nloc, nsh, perm0, m)
+    hit = _cache.get(key) if key is not None else None
+    if hit is not None:
+        out, stats = _thaw_out(items, hit[0]), hit[1]
+    else:
+        t0 = time.perf_counter()
+        out, stats = _optimize(items, n, nloc, nsh, perm0, m)
+        seconds = time.perf_counter() - t0
+        if not quiet:
+            _telemetry.observe("optimizer_seconds", seconds)
+        if key is not None:
+            if len(_cache) >= _CACHE_MAX:
+                _cache.pop(next(iter(_cache)))
+            _cache[key] = (_freeze_out(items, out), stats)
+    if not quiet and _telemetry.enabled():
+        for kind, v in stats["removed"].items():
+            if v:
+                _telemetry.inc("optimizer_gates_removed_total", v,
+                               kind=kind)
+        wb, wa = stats["windows_before"], stats["windows_after"]
+        if wb is not None and wa is not None and wb > wa:
+            _telemetry.inc("optimizer_windows_merged_total", wb - wa)
+    return list(out), stats
+
+
+def clear_cache() -> None:
+    """Drop memoized rewrites (tests; a mode flip does not need this —
+    the mode is part of the key)."""
+    _cache.clear()
+
+
+def summary_line() -> str:
+    """``Optimizer=...`` fragment for getEnvironmentString: the active
+    mode plus cumulative gates-removed/windows-merged when any work has
+    been recorded."""
+    m = mode()
+    removed = _telemetry.counter_total("optimizer_gates_removed_total")
+    merged = _telemetry.counter_total("optimizer_windows_merged_total")
+    s = f"Optimizer={m}"
+    if removed or merged:
+        s += f"(removed={int(removed)} windows_merged={int(merged)})"
+    return s
+
+
+# camelCase mirrors (the reference-style API surface)
+setCircuitOptimizer = set_circuit_optimizer
+getCircuitOptimizer = get_circuit_optimizer

@@ -711,11 +711,19 @@ class WindowExecutor:
         if self.faults is not None:
             self.faults.maybe_kill(self.window)
             self.faults.arm_exchange_window(self.window)
-        _fusion.start_gate_fusion(self.qureg)
-        try:
-            self.qureg._fusion.gates.extend(self.gates[self.cursor:end])
-        finally:
-            _fusion.stop_gate_fusion(self.qureg)  # drain: the window pass
+        # the checkpoint cursor indexes the RAW gate list and a resume
+        # may land on a different mesh/perm than this step runs under, so
+        # the cost-gated circuit rewrite must not fire per window — see
+        # optimizer.suppressed
+        from . import optimizer as _opt
+
+        with _opt.suppressed():
+            _fusion.start_gate_fusion(self.qureg)
+            try:
+                self.qureg._fusion.gates.extend(
+                    self.gates[self.cursor:end])
+            finally:
+                _fusion.stop_gate_fusion(self.qureg)  # the window pass
         self.cursor = end
         self._bi += 1
         return end

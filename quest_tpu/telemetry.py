@@ -176,6 +176,10 @@ HIST_BOUNDS = {
     "fusion_window_gates": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
     "fusion_remap_window_items": (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
                                   1024),
+    # circuit-optimizer rewrite time (optimizer.optimize_items): pure
+    # host work that should sit well under a drain's planning cost, so
+    # the low decades get extra resolution
+    "optimizer_seconds": (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 60.0),
     # serving-layer queue wait (serve.SimServer): interactive jobs on a
     # loaded server should sit in the sub-ms..100ms decades, so the low
     # end gets the same extra resolution as exchange latency
@@ -535,6 +539,27 @@ def perf_report(env=None) -> str:
     if tier_lines:
         lines.append("exchange tiers (per-shard bytes by interconnect):")
         lines.extend(tier_lines)
+    # circuit-optimizer activity (optimizer.py, docs/design.md §26):
+    # stream rewrites ahead of the fusion planner, by transform kind
+    removed = counter_total("optimizer_gates_removed_total")
+    wmerged = counter_total("optimizer_windows_merged_total")
+    if removed or wmerged:
+        from . import optimizer as _optimizer
+
+        by_kind = " ".join(
+            f"{k}={_num(counter_sum('optimizer_gates_removed_total', kind=k))}"
+            for k in ("cancel", "merge", "diag_coalesce")
+            if counter_sum("optimizer_gates_removed_total", kind=k))
+        lines.append(f"circuit optimizer (mode={_optimizer.mode()}):")
+        lines.append(f"  gates removed: total={_num(removed)} {by_kind}")
+        lines.append(f"  remap windows merged: {_num(wmerged)}")
+        secs = snap["histograms"].get("optimizer_seconds", {})
+        tot_n = sum(hd["count"] for hd in secs.values())
+        if tot_n:
+            tot_s = sum(hd["sum"] for hd in secs.values())
+            lines.append(
+                f"  optimize time: count={tot_n} "
+                f"mean={tot_s / tot_n:.6g}s")
     pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
     meas_c = counter_sum("exchanges_total", op="window_remap")
     pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")

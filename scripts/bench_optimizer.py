@@ -1,0 +1,218 @@
+"""Circuit-optimizer A/B (ISSUE 13 acceptance): QT_OPTIMIZER=on vs off
+on the workloads the rewrite targets, measuring what the optimizer
+claims to improve — executed gate count, window-remap exchange
+dispatches, and wall clock — with amplitude parity checked between arms.
+
+Three workloads, all on the 8-shard dryrun mesh:
+
+* ``random``  — a config-2-style seeded random circuit (H/X/S/T/rotations/
+  CNOT/CZ/SWAP mix): the honest generic stream, where wins come from
+  incidental same-target runs merging;
+* ``qft``     — a QFT-like phase-heavy ladder (H + controlled-phase
+  chains): maximal diagonal-coalescing surface, the reordering pass
+  clusters the commuting phase gates around the H barriers;
+* ``churn``   — the config-6-style alternating shard-local /
+  sharded-target stream: commutation-aware reordering clusters gates by
+  target locality so the window planner emits far fewer remap sigmas.
+
+Per arm the script records best-of-``reps`` drain wall-clock, the
+telemetry ``exchanges_total{op=window_remap}`` counter, the optimizer's
+own gates in/out, and ``model_drift_total`` (must stay 0 — §21 prices
+the optimized stream).  The headline metric is ``optimizer_speedup_x``
+(total off-seconds / on-seconds across workloads).
+
+Usage: python scripts/bench_optimizer.py [--n 10] [--depth 24]
+       [--reps 3] [--no-check]
+Needs the 8-device virtual mesh (make verify-optimizer).  --no-check
+skips the gating asserts (parity, drift, exchange non-regression).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import optimizer as OPT  # noqa: E402
+from quest_tpu import telemetry as T  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    qt.set_precision(2)  # f64 parity tolerance for the CPU dryrun
+
+# amplitude-parity budget between arms (reordering changes the floating
+# point evaluation order; cancel/merge alone is bit-identical)
+PARITY_TOL = 1e-10 if qt.get_precision() == 2 else 1e-4
+
+
+def _arg(flag, default, cast=int):
+    return cast(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def _unitary(rng, k):
+    d = 1 << k
+    g = rng.standard_normal((d, d)) + 1j * rng.standard_normal((d, d))
+    u, _r = np.linalg.qr(g)
+    return u
+
+
+def _random_ops(q, n, depth, seed=7):
+    rng = np.random.default_rng(seed)
+    for _ in range(depth):
+        kind = int(rng.integers(0, 9))
+        t = int(rng.integers(0, n))
+        u = int(rng.integers(0, n - 1))
+        th = float(rng.uniform(0, 2 * np.pi))
+        [lambda: qt.hadamard(q, t),
+         lambda: qt.pauliX(q, t),
+         lambda: qt.tGate(q, t),
+         lambda: qt.sGate(q, t),
+         lambda: qt.rotateZ(q, t, th),
+         lambda: qt.rotateX(q, t, th),
+         lambda: qt.controlledNot(q, u, u + 1),
+         lambda: qt.controlledPhaseFlip(q, u, u + 1),
+         lambda: qt.phaseShift(q, t, th)][kind]()
+
+
+def _qft_ops(q, n, depth, seed=0):
+    del seed
+    for _ in range(max(1, depth // (n * 2))):
+        for t in range(n):
+            qt.hadamard(q, t)
+            for u in range(t + 1, n):
+                qt.controlledPhaseShift(q, u, t, np.pi / (1 << (u - t)))
+
+
+def _churn_ops(q, n, depth, seed=11):
+    """Config-6-style remap churn: a repeating cycle of disjoint 2q
+    unitaries covering MORE qubits than fit shard-local, so the raw
+    window planner breaks a window every cycle; the optimizer merges the
+    per-pair repeats into one gate each, collapsing the churn."""
+    rng = np.random.default_rng(seed)
+    pairs = [(i, i + 1) for i in range(0, n - 1, 2)]
+    mats = {p: _unitary(rng, 2) for p in pairs}
+    for i in range(depth):
+        p = pairs[i % len(pairs)]
+        qt.multiQubitUnitary(q, list(p), mats[p])
+
+
+WORKLOADS = {"random": _random_ops, "qft": _qft_ops, "churn": _churn_ops}
+
+
+def _run_arm(env, build, mode, n, depth, reps):
+    """One optimizer arm of one workload: best-of-reps fused drain."""
+    qt.setCircuitOptimizer(mode)
+    best = float("inf")
+    amps = None
+    gates_in = gates_out = 0
+    exchanges = drift = 0
+    for rep in range(reps + 1):  # rep 0 = warm-up/compile
+        T.reset()
+        q = qt.createQureg(n, env)
+        qt.startGateFusion(q)
+        build(q, n, depth)
+        gates_in = len(q._fusion.gates)
+        t0 = time.perf_counter()
+        qt.stopGateFusion(q)
+        amps = np.asarray(q.amps)
+        seconds = time.perf_counter() - t0
+        if rep:
+            best = min(best, seconds)
+        gates_out = gates_in - int(
+            T.counter_total("optimizer_gates_removed_total"))
+        exchanges = int(T.counter_sum("exchanges_total", op="window_remap"))
+        drift = int(T.counter_total("model_drift_total"))
+    return {"mode": mode, "seconds": round(best, 4),
+            "gates_in": gates_in, "gates_out": gates_out,
+            "window_remap_exchanges": exchanges, "drift": drift}, amps
+
+
+def run(n=10, depth=24, reps=3):
+    env = qt.createQuESTEnv()
+    if env.num_devices < 8:
+        raise RuntimeError(
+            "bench_optimizer needs the 8-device virtual mesh — run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    prev_mode = T.mode_name()
+    T.configure("on")
+    results = {}
+    try:
+        for name, build in WORKLOADS.items():
+            off, a_off = _run_arm(env, build, "off", n, depth, reps)
+            on, a_on = _run_arm(env, build, "on", n, depth, reps)
+            results[name] = {
+                "off": off, "on": on,
+                "speedup_x": round(off["seconds"]
+                                   / max(on["seconds"], 1e-9), 2),
+                "exchange_reduction_x": round(
+                    off["window_remap_exchanges"]
+                    / max(on["window_remap_exchanges"], 1), 2),
+                "max_abs_err": float(np.abs(a_on - a_off).max()),
+            }
+    finally:
+        qt.setCircuitOptimizer(None)
+        T.reset()
+        T.configure(prev_mode)
+    total_off = sum(r["off"]["seconds"] for r in results.values())
+    total_on = sum(r["on"]["seconds"] for r in results.values())
+    return {
+        "bench": "optimizer_ab",
+        "n": n, "depth": depth, "reps": reps,
+        "backend": jax.default_backend(),
+        "devices": env.num_devices,
+        "mode_default": OPT.mode(),
+        "workloads": results,
+        "optimizer_speedup_x": round(total_off / max(total_on, 1e-9), 2),
+    }
+
+
+def main():
+    rec = run(n=_arg("--n", 10), depth=_arg("--depth", 24),
+              reps=_arg("--reps", 3))
+    print(json.dumps(rec), flush=True)
+    if "--no-check" in sys.argv:
+        return 0
+    ok = True
+    for name, r in rec["workloads"].items():
+        if r["max_abs_err"] > PARITY_TOL:
+            print(f"FAIL: {name} on/off amplitude mismatch "
+                  f"{r['max_abs_err']:.3e} — the rewrite must be "
+                  f"semantics-preserving", file=sys.stderr)
+            ok = False
+        for arm in ("off", "on"):
+            if r[arm]["drift"]:
+                print(f"FAIL: {name}/{arm} model_drift_total="
+                      f"{r[arm]['drift']} (§21 must price the stream "
+                      f"actually drained)", file=sys.stderr)
+                ok = False
+        if r["on"]["window_remap_exchanges"] > \
+                r["off"]["window_remap_exchanges"]:
+            print(f"FAIL: {name} optimized drain issued MORE window-remap "
+                  f"exchanges ({r['on']['window_remap_exchanges']} > "
+                  f"{r['off']['window_remap_exchanges']})", file=sys.stderr)
+            ok = False
+    if rec["workloads"]["churn"]["on"]["gates_out"] >= \
+            rec["workloads"]["churn"]["on"]["gates_in"]:
+        print("FAIL: churn optimizer removed nothing", file=sys.stderr)
+        ok = False
+    if rec["workloads"]["churn"]["exchange_reduction_x"] < 1.5:
+        print("FAIL: churn exchange reduction "
+              f"{rec['workloads']['churn']['exchange_reduction_x']}x is "
+              "below the 1.5x budget", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

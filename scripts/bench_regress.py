@@ -40,7 +40,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # units where larger is better; timing medians are lower-better
-_RATE_UNITS = ("per_sec", "per_second", "reduction")
+_RATE_UNITS = ("per_sec", "per_second", "reduction", "speedup")
 
 # variant sub-dicts of a bench.py per-config record that carry a
 # {"median": ...} timing (kdiff, eager, fused_sweep_on, api_wall, ...)

@@ -32,6 +32,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# both arms must replay the LITERAL gate stream: the continuous arm is
+# window-stepped (circuit optimizer suppressed — see
+# optimizer.suppressed), so the batch-at-once baseline must not get an
+# optimizer rewrite the serving path cannot
+os.environ.setdefault("QT_OPTIMIZER", "off")
+
 import jax  # noqa: E402
 
 if jax.default_backend() == "cpu":

@@ -28,6 +28,18 @@ from quest_tpu.parallel import dist
 
 
 @pytest.fixture(autouse=True)
+def raw_planner(monkeypatch):
+    """This suite pins the RAW planner cost model (window counts and
+    exchange predictions derived from the literal gate stream), so the
+    circuit optimizer is disabled here; its own contract is pinned by
+    tests/test_optimizer.py."""
+    monkeypatch.setenv("QT_OPTIMIZER", "off")
+    from quest_tpu import optimizer as _opt
+    _opt.clear_cache()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def tele():
     """Telemetry on + a clean registry per test (mode restored after)."""
     prev = T.mode_name()

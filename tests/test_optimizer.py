@@ -1,0 +1,478 @@
+"""Cost-model-guided circuit optimizer (ISSUE 13): the pre-planner
+rewrite contract.
+
+The load-bearing acceptance facts pinned here:
+
+  * cancellation and merging are SEMANTICS-PRESERVING — an optimized
+    drain agrees with the unoptimized drain on every path (scalar,
+    8-shard, batched bank), and a cancellation-only rewrite is
+    BIT-identical to draining the stream with the cancelled pair simply
+    absent;
+  * the §21 reconciliation contract survives: ``model_drift_total == 0``
+    on optimized sharded drains, because predictions are priced on the
+    OPTIMIZED stream;
+  * the optimizer mode is part of the fusion plan-cache key — flipping
+    ``QT_OPTIMIZER`` retraces instead of replaying a stale plan;
+  * telemetry counters / the explain section / the env-string fragment
+    surface the rewrite's accounting.
+
+tests/test_introspect.py pins the RAW planner model with the optimizer
+forced off; this suite owns the optimized contract.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu import fusion
+from quest_tpu import introspect
+from quest_tpu import optimizer as OPT
+from quest_tpu import telemetry as T
+from quest_tpu.validation import QuESTError
+
+
+@pytest.fixture(autouse=True)
+def opt_state(monkeypatch):
+    """Default-on optimizer, no env override, clean rewrite cache."""
+    monkeypatch.delenv("QT_OPTIMIZER", raising=False)
+    OPT.set_circuit_optimizer(None)
+    OPT.clear_cache()
+    yield
+    OPT.set_circuit_optimizer(None)
+    OPT.clear_cache()
+
+
+@pytest.fixture(autouse=True)
+def tele():
+    prev = T.mode_name()
+    T.configure("on")
+    T.reset()
+    yield T
+    T.reset()
+    T.configure(prev)
+
+
+@pytest.fixture
+def env8(env):
+    if env.num_devices < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    return env
+
+
+def _soa(m):
+    m = np.asarray(m, dtype=complex)
+    return np.stack([m.real, m.imag])
+
+
+X = _soa([[0, 1], [1, 0]])
+H = _soa(np.array([[1, 1], [1, -1]]) / np.sqrt(2))
+Z = _soa([[1, 0], [0, -1]])
+S = _soa([[1, 0], [0, 1j]])
+TG = _soa([[1, 0], [0, np.exp(1j * np.pi / 4)]])
+CX = _soa([[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0]])
+
+
+def _g(targets, mat):
+    return CIRC.Gate(tuple(targets), mat)
+
+
+def _opt(items, n=4, nloc=4, nsh=0, perm0=None):
+    return OPT.optimize_items(items, n=n, nloc=nloc, nsh=nsh,
+                              perm0=perm0, quiet=True)
+
+
+# ---------------------------------------------------------------------------
+# Unit: the rewrite itself
+# ---------------------------------------------------------------------------
+
+
+class TestRewrite:
+    def test_xx_pair_cancels_exactly(self):
+        out, stats = _opt([_g((0,), X), _g((0,), X)])
+        assert out == []
+        assert stats["removed"]["cancel"] == 2
+        assert stats["gates_in"] == 2 and stats["gates_out"] == 0
+
+    def test_cnot_pair_cancels_through_disjoint_gate(self):
+        # the middle H(2) is support-disjoint, so the second CNOT reaches
+        # back through it to cancel the first
+        out, stats = _opt(
+            [_g((0, 1), CX), _g((2,), H), _g((0, 1), CX)])
+        assert [it.targets for it in out] == [(2,)]
+        assert stats["removed"]["cancel"] == 2
+
+    def test_hh_merges_not_cancels(self):
+        # H·H is identity only up to rounding (off-diagonals ~2e-17 in
+        # f64) — under "on" it must MERGE, preserving bit-exactness
+        out, stats = _opt([_g((0,), H), _g((0,), H)])
+        assert len(out) == 1
+        assert stats["removed"]["merge"] == 1
+        assert stats["removed"]["cancel"] == 0
+        np.testing.assert_array_equal(
+            out[0].mat, CIRC.soa_matmul(H, H))
+
+    def test_aggressive_drops_near_identity(self):
+        OPT.set_circuit_optimizer("aggressive")
+        out, stats = _opt([_g((0,), H), _g((0,), H)])
+        assert out == []
+        assert stats["removed"]["cancel"] == 2
+
+    def test_merge_matmul_order_is_new_at_old(self):
+        # stream order S then T: the merged gate must be T @ S
+        out, _ = _opt([_g((0,), S), _g((1,), H), _g((0,), TG)])
+        merged = [it for it in out if it.targets == (0,)]
+        assert len(merged) == 1
+        np.testing.assert_allclose(
+            merged[0].mat, CIRC.soa_matmul(TG, S), atol=1e-15)
+
+    def test_channel_blocks_composition(self):
+        # a channel on the same ket bit is a barrier: the two X's must
+        # NOT compose across it, and the channel itself is never dropped
+        ch = fusion.ChannelItem("depolarising", 0, 3, 0.1)
+        out, stats = _opt([_g((0,), X), ch, _g((0,), X)], n=6, nloc=6)
+        assert len(out) == 3 and out[1] is ch
+        assert stats["removed"]["cancel"] == 0
+        assert stats["removed"]["merge"] == 0
+
+    def test_diag_run_coalesces_to_union_gate(self):
+        # T(0) first merges into Z(0) through the commuting S(1); the
+        # two surviving diagonals then coalesce into one union gate
+        out, stats = _opt([_g((0,), Z), _g((1,), S), _g((0,), TG)])
+        assert len(out) == 1
+        assert stats["removed"]["merge"] == 1
+        assert stats["removed"]["diag_coalesce"] == 1
+        fused = out[0]
+        assert fused.targets == (0, 1)
+        # the fused diagonal equals the elementwise product of the run
+        want = np.kron(np.diag([1, 1j]),          # S on qubit 1
+                       np.diag([1, -1]) @ np.diag(
+                           [1, np.exp(1j * np.pi / 4)]))  # Z·T on 0
+        got = fused.mat[0] + 1j * fused.mat[1]
+        np.testing.assert_allclose(got, want, atol=1e-15)
+
+    def test_traced_stream_left_untouched(self):
+        import jax.numpy as jnp
+
+        items = [_g((0,), jnp.asarray(X)), _g((0,), jnp.asarray(X))]
+        out, stats = _opt(items)
+        assert out == items
+        assert stats["gates_in"] == stats["gates_out"] == 2
+
+    def test_off_mode_is_a_noop(self):
+        OPT.set_circuit_optimizer("off")
+        items = [_g((0,), X), _g((0,), X)]
+        out, stats = _opt(items)
+        assert out == items and stats["mode"] == "off"
+
+    def test_mode_knob_validation_and_override(self, monkeypatch):
+        with pytest.raises(QuESTError):
+            qt.setCircuitOptimizer("bogus")
+        monkeypatch.setenv("QT_OPTIMIZER", "aggressive")
+        assert qt.getCircuitOptimizer() == "aggressive"
+        qt.setCircuitOptimizer("off")           # override beats env
+        assert qt.getCircuitOptimizer() == "off"
+        qt.setCircuitOptimizer(None)
+        assert qt.getCircuitOptimizer() == "aggressive"
+
+
+# ---------------------------------------------------------------------------
+# Integration: drain parity on every path
+# ---------------------------------------------------------------------------
+
+
+def _random_program(n, depth, seed):
+    """Randomized API-level circuit mixing mergeable/cancellable/diagonal
+    structure with generic entanglers."""
+    rng = np.random.default_rng(seed)
+    ops = []
+    for _ in range(depth):
+        kind = rng.integers(0, 10)
+        t = int(rng.integers(0, n))
+        u = int(rng.integers(0, n - 1))
+        v = u + 1
+        th = float(rng.uniform(0, 2 * np.pi))
+        ops.append((kind, t, u, v, th))
+    return ops
+
+
+def _apply_program(q, ops):
+    for kind, t, u, v, th in ops:
+        if kind == 0:
+            qt.hadamard(q, t)
+        elif kind == 1:
+            qt.pauliX(q, t)
+        elif kind == 2:
+            qt.tGate(q, t)
+        elif kind == 3:
+            qt.sGate(q, t)
+        elif kind == 4:
+            qt.rotateZ(q, t, th)
+        elif kind == 5:
+            qt.rotateX(q, t, th)
+        elif kind == 6:
+            qt.controlledNot(q, u, v)
+        elif kind == 7:
+            qt.controlledPhaseFlip(q, u, v)
+        elif kind == 8:
+            qt.swapGate(q, u, v)
+        else:
+            qt.phaseShift(q, t, th)
+
+
+class TestDrainParity:
+    # two seeds in tier-1; the deeper sweep rides the unfiltered
+    # make verify-optimizer run (slow marker)
+    @pytest.mark.parametrize(
+        "seed", [0, 1,
+                 pytest.param(2, marks=pytest.mark.slow),
+                 pytest.param(3, marks=pytest.mark.slow)])
+    def test_randomized_parity_scalar(self, env, seed):
+        n = 5
+        ops = _random_program(n, 40, seed)
+        amps = {}
+        for mode in ("on", "off", "aggressive"):
+            qt.setCircuitOptimizer(mode)
+            q = qt.createQureg(n, env)
+            with qt.gateFusion(q):
+                _apply_program(q, ops)
+            amps[mode] = np.asarray(q.amps)
+        np.testing.assert_allclose(amps["on"], amps["off"], atol=1e-10)
+        np.testing.assert_allclose(amps["aggressive"], amps["off"],
+                                   atol=1e-10)
+
+    @pytest.mark.parametrize(
+        "seed", [5, pytest.param(6, marks=pytest.mark.slow)])
+    def test_randomized_parity_sharded_with_zero_drift(self, env8, seed):
+        n = 7  # 3 sharded qubits over the 8-device mesh
+        ops = _random_program(n, 48, seed)
+        amps = {}
+        for mode in ("on", "off"):
+            qt.setCircuitOptimizer(mode)
+            T.reset()
+            q = qt.createQureg(n, env8)
+            with qt.gateFusion(q):
+                _apply_program(q, ops)
+            amps[mode] = np.asarray(q.amps)
+            # §21: predictions are priced on the stream the drain
+            # actually executed, so the optimizer cannot introduce drift
+            assert T.counter_total("model_drift_total") == 0
+        np.testing.assert_allclose(amps["on"], amps["off"], atol=1e-10)
+
+    def test_randomized_parity_batched_bank(self, env):
+        # n chosen so 2-qubit gates stay shard-local on the 8-device
+        # mesh (nloc = n - 3 >= 2): wider-than-local gates fall out of
+        # the batched capture path entirely
+        n, B = 6, 3
+        ops = _random_program(n, 24, seed=9)
+        thetas = np.linspace(0.2, 1.1, B)
+        mats = np.stack([
+            np.array([[np.cos(a), -np.sin(a)], [np.sin(a), np.cos(a)]],
+                     dtype=complex) for a in thetas])
+        amps = {}
+        for mode in ("on", "off"):
+            qt.setCircuitOptimizer(mode)
+            bq = qt.createBatchedQureg(n, env, B)
+            qt.startGateFusion(bq)
+            _apply_program(bq, ops[:12])
+            qt.applyBatchedUnitary(bq, (2,), mats)
+            qt.pauliX(bq, 1)
+            qt.pauliX(bq, 1)
+            _apply_program(bq, ops[12:])
+            qt.stopGateFusion(bq)
+            amps[mode] = np.asarray(bq.amps)
+        np.testing.assert_allclose(amps["on"], amps["off"], atol=1e-10)
+
+    def test_density_channel_parity(self, env):
+        amps = {}
+        for mode in ("on", "off"):
+            qt.setCircuitOptimizer(mode)
+            q = qt.createDensityQureg(3, env)
+            qt.startGateFusion(q)
+            qt.hadamard(q, 0)
+            qt.controlledNot(q, 0, 1)
+            qt.mixDepolarising(q, 0, 0.05)
+            qt.pauliX(q, 2)
+            qt.pauliX(q, 2)
+            qt.mixDamping(q, 1, 0.1)
+            qt.stopGateFusion(q)
+            amps[mode] = np.asarray(q.amps)
+        np.testing.assert_allclose(amps["on"], amps["off"], atol=1e-12)
+
+    def test_cancellation_only_stream_is_bit_identical(self, env):
+        """A stream whose only rewrite is an exact-identity cancellation
+        must drain BIT-identically to the stream with the pair absent."""
+        base = [_g((1,), H), _g((0, 1), CX)]
+        pair = [_g((0,), X), _g((0,), X)]
+
+        qt.setCircuitOptimizer("on")
+        q1 = qt.createQureg(6, env)
+        fusion.start_gate_fusion(q1)
+        q1._fusion.gates.extend(base + pair)
+        fusion.stop_gate_fusion(q1)
+
+        qt.setCircuitOptimizer("off")
+        q2 = qt.createQureg(6, env)
+        fusion.start_gate_fusion(q2)
+        q2._fusion.gates.extend(base)
+        fusion.stop_gate_fusion(q2)
+
+        np.testing.assert_array_equal(np.asarray(q1.amps),
+                                      np.asarray(q2.amps))
+
+    def test_everything_cancels_drains_to_initial_state(self, env):
+        q = qt.createQureg(6, env)
+        with qt.gateFusion(q):
+            qt.pauliX(q, 0)
+            qt.pauliX(q, 0)
+            qt.controlledNot(q, 1, 2)
+            qt.controlledNot(q, 1, 2)
+        want = np.zeros((2, 64))  # SoA planes of |0...0>
+        want[0, 0] = 1.0
+        np.testing.assert_array_equal(np.asarray(q.amps), want)
+
+    def test_seeded_measurement_parity_through_run_resumable(
+            self, env, tmp_path):
+        """Cancel/merge-only rewrites keep the amplitude stream
+        bit-identical, so a seeded measurement sequence after a
+        run_resumable drain lands on the SAME outcomes on vs off."""
+        n = 6
+        gates = []
+        for t in range(n):
+            gates.append(_g((t,), H))
+        gates += [_g((0,), X), _g((0,), X),
+                  _g((1, 2), CX), _g((1, 2), CX),
+                  _g((2,), TG), _g((2,), S)]
+        outcomes = {}
+        for mode in ("on", "off"):
+            qt.setCircuitOptimizer(mode)
+            qt.seedQuEST(env, [1234])
+            q = qt.createQureg(n, env)
+            qt.run_resumable(q, gates, str(tmp_path / f"ck-{mode}"),
+                             every=4)
+            outcomes[mode] = [qt.measure(q, t) for t in range(n)]
+        assert outcomes["on"] == outcomes["off"]
+
+
+# ---------------------------------------------------------------------------
+# Scheduling composition: plan cache, windows, telemetry, reports
+# ---------------------------------------------------------------------------
+
+
+class TestComposition:
+    def test_mode_flip_retraces_plan(self, env):
+        """The optimizer mode is part of the fusion plan key: flipping it
+        must MISS the plan cache (and re-plan), never replay a plan built
+        under the other mode."""
+        def drain(mode):
+            qt.setCircuitOptimizer(mode)
+            q = qt.createQureg(4, env)
+            with qt.gateFusion(q):
+                qt.hadamard(q, 0)
+                qt.pauliX(q, 1)
+                qt.pauliX(q, 1)
+                qt.tGate(q, 2)
+            return qt.calcTotalProb(q)
+
+        drain("on")
+        before = T.snapshot()["counters"]
+        drain("on")     # identical stream + mode: cache hit
+        drain("off")    # mode flip: forced miss
+        after = T.snapshot()["counters"]
+
+        def delta(name):
+            return (sum(after.get(name, {}).values())
+                    - sum(before.get(name, {}).values()))
+
+        assert delta("fusion_plan_cache_hits_total") == 1
+        assert delta("fusion_plan_cache_misses_total") == 1
+
+    def test_sharded_windows_merged_and_exchange_reduction(self, env8):
+        """The acceptance metric: on the pinned merge-across-commuting
+        stream, the optimized drain issues FEWER window-remap exchanges
+        and records optimizer_windows_merged_total — with zero drift."""
+        rng = np.random.default_rng(3)
+        g = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+        u, _ = np.linalg.qr(g)
+        n = 6
+
+        def drain(mode):
+            qt.setCircuitOptimizer(mode)
+            T.reset()
+            q = qt.createQureg(n, env8)
+            qt.startGateFusion(q)
+            for ts in [(0, 1), (n - 2, n - 1), (0, 1)]:
+                qt.multiQubitUnitary(q, list(ts), u)
+            qt.stopGateFusion(q)
+            amps = np.asarray(q.amps)
+            ex = T.counter_sum("exchanges_total", op="window_remap")
+            merged = T.counter_total("optimizer_windows_merged_total")
+            assert T.counter_total("model_drift_total") == 0
+            return amps, ex, merged
+
+        a_off, ex_off, _m0 = drain("off")
+        a_on, ex_on, merged = drain("on")
+        assert ex_on < ex_off
+        assert merged >= 1
+        np.testing.assert_allclose(a_on, a_off, atol=1e-12)
+
+    def test_telemetry_counters_and_env_string(self, env):
+        qt.setCircuitOptimizer("on")
+        q = qt.createQureg(4, env)
+        with qt.gateFusion(q):
+            qt.pauliX(q, 0)
+            qt.pauliX(q, 0)
+            qt.hadamard(q, 1)
+            qt.hadamard(q, 1)
+        snap = T.snapshot()
+        removed = snap["counters"].get(
+            "optimizer_gates_removed_total", {})
+        assert any("kind=cancel" in k for k in removed)
+        assert any("kind=merge" in k for k in removed)
+        assert sum(removed.values()) >= 3
+        assert "optimizer_seconds" in snap["histograms"]
+        s = qt.getEnvironmentString(env)
+        assert "Optimizer=on" in s
+        assert "removed=" in s
+
+    def test_explain_section_and_reports(self, env8, capsys):
+        q = qt.createQureg(6, env8)
+        qt.startGateFusion(q)
+        qt.pauliX(q, 0)
+        qt.pauliX(q, 0)
+        qt.tGate(q, 4)
+        qt.sGate(q, 5)
+        rep = introspect.explain_circuit(q)
+        opt = rep["optimizer"]
+        assert opt["mode"] == "on"
+        assert opt["gates_in"] == 4
+        assert opt["gates_out"] < opt["gates_in"]
+        assert opt["removed"]["cancel"] == 2
+        assert opt["tier_savings_bytes"] is not None
+        assert opt["exchange_savings"] is not None
+        qt.reportCircuitPlan(q)
+        out = capsys.readouterr().out
+        assert "optimizer: mode=on" in out
+        # explain is a dry run: the buffer must still drain afterwards
+        qt.stopGateFusion(q)
+        T.report_perf(env8)
+        out = capsys.readouterr().out
+        assert "circuit optimizer" in out
+
+    def test_explain_never_mutates_telemetry(self, env):
+        q = qt.createQureg(4, env)
+        qt.startGateFusion(q)
+        qt.pauliX(q, 0)
+        qt.pauliX(q, 0)
+        before = T.snapshot()
+        introspect.explain_circuit(q)
+        assert T.snapshot() == before
+        qt.stopGateFusion(q)
+
+    def test_rewrite_cache_hit_skips_recompute(self, env):
+        items = [_g((0,), X), _g((0,), X), _g((1,), H)]
+        out1, s1 = _opt(items)
+        out2, s2 = _opt(list(items))
+        assert s1 == s2
+        assert [it.targets for it in out1] == \
+            [it.targets for it in out2] == [(1,)]

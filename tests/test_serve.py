@@ -35,6 +35,20 @@ from quest_tpu import telemetry as T
 N = 4
 
 
+@pytest.fixture(autouse=True)
+def raw_stream(monkeypatch):
+    """Serving pins window-stepped execution bit-identical to a plain
+    drain of the SAME literal gate stream.  Window-stepped drains always
+    run with the circuit optimizer suppressed (optimizer.suppressed —
+    the checkpoint cursor indexes raw gates and resume may change
+    mesh/perm), so the plain-drain baselines here must be raw too; the
+    optimizer's own parity contracts live in tests/test_optimizer.py."""
+    monkeypatch.setenv("QT_OPTIMIZER", "off")
+    from quest_tpu import optimizer as _opt
+    _opt.clear_cache()
+    yield
+
+
 def _h(t):
     m = np.array([[1.0, 1.0], [1.0, -1.0]]) / np.sqrt(2.0)
     return C.Gate((t,), np.stack([m, np.zeros((2, 2))]))
