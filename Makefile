@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-regress bench docs clean
 
 all: native
 
@@ -53,9 +53,19 @@ verify-optimizer:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_optimizer.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_optimizer.py
 
+# Serving-layer fault tolerance (docs/design.md §27): the retry /
+# quarantine / failover / heal unit suite plus the seeded chaos harness
+# — three seeds covering bank faults, checkpoint-IO faults, shard AND
+# host loss + mesh heal, OOM bisection, and NaN poison, asserting
+# bit-identical completions vs the fault-free replay, zero cross-tenant
+# propagation, bounded-step idle, and 100% non-poison availability.
+verify-chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 QT_TOPOLOGY=2x4 python scripts/chaos_serve.py
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static verify-serve verify-optimizer
+verify: verify-static verify-serve verify-optimizer verify-chaos
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

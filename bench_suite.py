@@ -723,9 +723,40 @@ def config14():
            for name, r in w.items()})
 
 
+def config15():
+    """Serving-layer chaos replay (ISSUE 14): the seeded fault-injection
+    harness (scripts/chaos_serve.py) replays three deterministic
+    multi-tenant traces — fault-free baseline vs a FaultPlan covering
+    bank faults, checkpoint-IO faults, shard AND host loss + mesh heal,
+    OOM bisection, and a NaN-poisoned job.  The timing line carries the
+    non-poison availability headline (must be 100%, gated separately by
+    make verify-chaos) plus failover MTTR, bit-identity, and the
+    retry/quarantine/failover/heal counters."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import chaos_serve
+
+    t0 = time.perf_counter()
+    rec = chaos_serve.run()
+    _set_compile(0.0)  # A/B replays warm inside run()
+    _emit(15, "serving chaos replay non-poison availability",
+          rec["availability_pct"], "chaos_availability_pct",
+          round(time.perf_counter() - t0, 3),
+          {"ok": rec["ok"],
+           "failover_mttr_seconds": rec["failover_mttr_seconds"],
+           "failovers": rec["failovers"],
+           "heals": rec["heals"],
+           "bank_retries": rec["bank_retries"],
+           "quarantined": rec["quarantined"],
+           "bit_identical": rec["bit_identical"],
+           "completed": rec["completed"],
+           "seeds": rec["seeds"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13, 14: config14}
+           11: config11, 12: config12, 13: config13, 14: config14,
+           15: config15}
 
 
 def main():

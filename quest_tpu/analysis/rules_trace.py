@@ -457,6 +457,54 @@ class NondeterminismRule(Rule):
 
 
 @register
+class FaultPlanSpecRule(Rule):
+    id = "fault-plan-spec"
+    doc = ("string fault schedule passed to resilience.FaultPlan must be "
+           "comma-joined kind@N events with registered kinds — a typo'd "
+           "kind raises at plan construction, and in an env default it "
+           "silently never fires")
+
+    # the registered fault vocabulary, INCLUDING the serve-level kinds
+    # (bank_fault/heal/poison_job).  Kept in sync with
+    # resilience.FaultPlan._KINDS plus the "io" spec-only form; pinned by
+    # tests/test_serve_resilience.py.
+    KINDS = frozenset({
+        "kill", "killsave", "corrupt", "io", "nan", "inf", "scale",
+        "stall", "shard_loss", "host_loss", "oom",
+        "bank_fault", "heal", "poison_job",
+    })
+
+    def check(self, tree, src, path) -> Iterator[Finding]:
+        for node in _all_nodes(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fname = _dotted(node.func)
+            if fname is None or fname[-1] != "FaultPlan":
+                continue
+            spec = node.args[0]
+            if not isinstance(spec, ast.Constant) \
+                    or not isinstance(spec.value, str):
+                continue  # dynamic specs are validated at run time
+            for part in spec.value.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                kind, sep, arg = part.partition("@")
+                kind = kind.strip()
+                if kind not in self.KINDS:
+                    yield self.finding(
+                        path, spec,
+                        f"unknown fault kind {kind!r} in FaultPlan spec "
+                        f"{spec.value!r} (registered: "
+                        f"{', '.join(sorted(self.KINDS))})")
+                elif sep and not arg.strip().lstrip("-").isdigit():
+                    yield self.finding(
+                        path, spec,
+                        f"non-integer argument {arg.strip()!r} for "
+                        f"{kind!r} in FaultPlan spec {spec.value!r}")
+
+
+@register
 class F64LiteralRule(Rule):
     id = "f64-literal"
     doc = ("float64/complex128 dtype literal outside precision.py and "

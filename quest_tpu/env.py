@@ -343,6 +343,17 @@ def get_environment_string(env: QuESTEnv) -> str:
     timeouts = telemetry.counter_total("exchange_timeouts_total")
     if timeouts:
         s += f" ExchangeTimeouts={int(timeouts)}"
+    # serving-resilience surface (serve.py, docs/design.md §27): retry /
+    # quarantine / failover+heal history and the live degraded flag
+    s_retr = telemetry.counter_total("serve_bank_retries_total")
+    s_quar = telemetry.counter_total("serve_jobs_quarantined_total")
+    s_fail = telemetry.counter_total("serve_failovers_total")
+    s_heal = telemetry.counter_total("serve_heals_total")
+    s_deg = telemetry.gauge_max("serve_degraded")
+    if s_retr or s_quar or s_fail or s_heal or s_deg:
+        s += (f" Serve=retries:{int(s_retr)},"
+              f"quarantined:{int(s_quar)},failovers:{int(s_fail)},"
+              f"heals:{int(s_heal)},degraded:{int(s_deg or 0)}")
     # peak HBM watermark over devices (hbm_watermark_bytes gauge, sampled
     # by the fusion drain at window boundaries — utils/profiling.py)
     peak = telemetry.gauge_max("hbm_watermark_bytes")

@@ -222,6 +222,26 @@ def register_bytes_per_device(qureg) -> int:
     return total
 
 
+def refresh_budget() -> None:
+    """Re-derive the per-device budget and re-price the ledger after the
+    live mesh changes shape (serve failover/heal, elastic failover): the
+    HBM probe cache is dropped — the next :func:`budget_bytes` re-probes
+    whatever devices survive — and every resident entry's per-device
+    bytes are recomputed against its register's CURRENT environment
+    (fewer devices -> more bytes per device, and vice versa on heal)."""
+    _DEVICE_LIMIT[0] = False
+    _DEVICE_LIMIT[1] = None
+    for key in list(_LEDGER):
+        e = _LEDGER.get(key)
+        q = e.ref() if e is not None else None
+        if q is None:
+            _LEDGER.pop(key, None)
+            continue
+        if not e.spilled:
+            e.bytes = register_bytes_per_device(q)
+    _telemetry.inc("governor_budget_rederivations_total")
+
+
 def _next_tick() -> int:
     _TICK[0] += 1
     return _TICK[0]

@@ -193,6 +193,7 @@ def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
         fault = hook(op) if hook is not None else None
         if fault == "shard_loss":
             _telemetry.inc("exchange_timeouts_total", op=op)
+            topo.notify_mesh_event("shard_loss", op=op, shard=None)
             raise ShardLossError(
                 f"injected shard loss during {op} dispatch", op=op)
         if fault == "host_loss":
@@ -201,6 +202,8 @@ def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
             # host (topology.host_of) and excludes that host's entire
             # device range from the surviving mesh
             _telemetry.inc("exchange_timeouts_total", op=op)
+            topo.notify_mesh_event("host_loss", op=op,
+                                   shard=int(shards) - 1)
             raise ShardLossError(
                 f"injected host loss during {op} dispatch", op=op,
                 shard=int(shards) - 1)
@@ -223,6 +226,8 @@ def guarded_dispatch(fn, *args, op: str = "exchange", shards: int = 1,
                 return out
         if k + 1 < attempts:
             _time.sleep(base_delay * (1 << k))
+    topo.notify_mesh_event("shard_loss", op=op, shard=None,
+                           exhausted_attempts=attempts)
     raise ShardLossError(
         f"{op} dispatch failed after {attempts} attempts "
         f"(last error: {last!r})", op=op) from last

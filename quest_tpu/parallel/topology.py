@@ -202,6 +202,62 @@ def shrink(topo: Optional[Topology], num_devices: int) -> Topology:
     return Topology(1, ndev)
 
 
+def grow(topo: Optional[Topology], num_devices: int) -> Topology:
+    """Topology of a HEALED mesh — the inverse of :func:`shrink` for the
+    serving layer's mesh-heal path (serve.SimServer.heal).  The spec
+    (``QT_TOPOLOGY``) wins when it factors the recovered device count —
+    healing restores the arrangement the operator declared (``1x4`` back
+    to ``2x4``); otherwise re-host the surviving chips-per-host shape."""
+    ndev = max(1, int(num_devices))
+    spec_topo = resolve(ndev)
+    if spec_topo.hosts > 1 or topo is None:
+        return spec_topo
+    if topo.chips <= ndev and ndev % topo.chips == 0 \
+            and _is_pow2(ndev // topo.chips):
+        return Topology(ndev // topo.chips, topo.chips)
+    return spec_topo
+
+
+# ---------------------------------------------------------------------------
+# Mesh loss/heal notification hooks
+# ---------------------------------------------------------------------------
+
+# Subsystems whose cached state depends on the live mesh shape register a
+# callback here: the serving layer hooks the memory governor's budget
+# re-derivation, dist.guarded_dispatch announces a declared shard/host
+# loss the instant it raises ShardLossError, and serve.SimServer
+# announces failover/heal after swapping its environment.  Callbacks take
+# ``(event: str, info: dict)``; an exception inside one is swallowed with
+# a warning — a notification fan-out that can fail would turn an
+# already-degraded moment into a crash.
+MESH_EVENT_LISTENERS: list = []
+
+
+def add_mesh_listener(cb) -> None:
+    if cb not in MESH_EVENT_LISTENERS:
+        MESH_EVENT_LISTENERS.append(cb)
+
+
+def remove_mesh_listener(cb) -> None:
+    try:
+        MESH_EVENT_LISTENERS.remove(cb)
+    except ValueError:
+        pass
+
+
+def notify_mesh_event(event: str, **info) -> None:
+    """Fan ``event`` ("shard_loss" / "host_loss" / "serve_failover" /
+    "serve_heal") out to every registered listener."""
+    import warnings
+
+    for cb in list(MESH_EVENT_LISTENERS):
+        try:
+            cb(event, dict(info))
+        except Exception as e:  # qlint: allow(broad-except): notification fan-out must never crash an already-degraded run
+            warnings.warn(f"mesh-event listener failed on {event!r}: {e!r}",
+                          RuntimeWarning, stacklevel=2)
+
+
 def hierarchical_enabled(topo: Optional[Topology]) -> bool:
     """Whether tier-aware remap planning is active: a multi-host
     topology AND the planner not forced flat.  Single-host meshes always

@@ -114,12 +114,17 @@ class NumericalHealthError(QuESTError):
 
     def __init__(self, msg: str, *, window: Optional[Tuple[int, int]] = None,
                  norm: Optional[float] = None, finite: bool = True,
-                 rolled_back_to: Optional[int] = None):
+                 rolled_back_to: Optional[int] = None,
+                 element: Optional[int] = None):
         super().__init__(msg)
         self.window = window
         self.norm = norm
         self.finite = finite
         self.rolled_back_to = rolled_back_to
+        # worst batch-element index on a BatchedQureg bank (None for a
+        # scalar register) — the serving layer's quarantine bisection
+        # uses it to attribute a poisoned bank to ONE member job
+        self.element = element
 
 
 # ---------------------------------------------------------------------------
@@ -135,19 +140,62 @@ _RETRY_BASE_ENV = "QT_RETRY_BASE_SECONDS"
 # consults it for injected transient errors
 _ACTIVE_FAULTS: List[Optional["FaultPlan"]] = [None]
 
+# env seed for the backoff-jitter stream (and the chaos harness): when
+# set, every retrier on this process jitters deterministically
+_CHAOS_SEED_ENV = "QT_CHAOS_SEED"
+
+# dedicated decorrelated-jitter stream — deliberately NOT GLOBAL_RNG
+# (that is the measurement stream; consuming it for sleep jitter would
+# shift measurement outcomes and break the retry bit-identity contract)
+_JITTER_RNG: List[Optional[object]] = [None]
+
+
+def seed_backoff_jitter(seeds: Optional[Sequence[int]] = None) -> None:
+    """(Re)seed the backoff-jitter stream.  Explicit ``seeds`` win, then
+    ``QT_CHAOS_SEED``; otherwise time+pid — jitter exists to DESYNCHRONIZE
+    concurrent retriers, so an unseeded default must differ per process."""
+    from . import rng as _rng
+
+    r = _rng._MeasurementRNG()
+    if seeds is None:
+        raw = os.environ.get(_CHAOS_SEED_ENV, "").strip()
+        if raw:
+            seeds = [int(raw)]
+        else:
+            seeds = [int(time.time() * 1e6), os.getpid()]  # qlint: allow(nondeterminism): unseeded jitter must decorrelate across processes; QT_CHAOS_SEED pins it
+    r.seed([int(s) for s in seeds])
+    _JITTER_RNG[0] = r
+
+
+def backoff_delay(base: float, prev: Optional[float]) -> float:
+    """One decorrelated-jitter backoff delay: uniform on
+    [base, min(64*base, 3*prev)], seeded from :func:`seed_backoff_jitter`.
+    Unlike the deterministic 1-2-4 ladder this never synchronizes a fleet
+    of retriers that failed at the same instant, while keeping the same
+    bounded envelope (never below ``base``, capped at ``64*base``)."""
+    if _JITTER_RNG[0] is None:
+        seed_backoff_jitter()
+    base = max(float(base), 1e-9)
+    cap = base * 64.0
+    prev = base if (prev is None or prev <= 0.0) else float(prev)
+    hi = max(base, min(cap, 3.0 * prev))
+    return base + (hi - base) * float(_JITTER_RNG[0].uniform())
+
 
 def retry_io(fn, *args, attempts: Optional[int] = None,
              base_delay: Optional[float] = None, what: str = "checkpoint IO",
              **kwargs):
     """Call ``fn`` retrying transient IO failures (OSError/TimeoutError)
-    with bounded exponential backoff — the wrapper around every orbax /
-    metadata save+load.  A persistent failure re-raises the last error
-    wrapped in a QuESTError naming the operation and attempt count."""
+    with bounded decorrelated-jitter backoff (:func:`backoff_delay`) —
+    the wrapper around every orbax / metadata save+load.  A persistent
+    failure re-raises the last error wrapped in a QuESTError naming the
+    operation and attempt count."""
     if attempts is None:
         attempts = int(os.environ.get(_RETRY_ATTEMPTS_ENV, "4"))
     if base_delay is None:
         base_delay = float(os.environ.get(_RETRY_BASE_ENV, "0.05"))
     last = None
+    delay: Optional[float] = None
     for k in range(max(1, attempts)):
         plan = _ACTIVE_FAULTS[0]
         if plan is not None and plan.take_io_fault():
@@ -159,7 +207,8 @@ def retry_io(fn, *args, attempts: Optional[int] = None,
                 last = e
         _telemetry.inc("checkpoint_io_retries_total", what=what)
         if k + 1 < attempts:
-            time.sleep(base_delay * (1 << k))
+            delay = backoff_delay(base_delay, delay)
+            time.sleep(delay)
     raise QuESTError(
         f"{what}: failed after {attempts} attempts "
         f"(last error: {last!r})") from last
@@ -209,15 +258,36 @@ class FaultPlan:
                       and retries; arming ``oom@W`` TWICE exhausts the
                       single retry and proves the net re-raises
 
+    Serve-level kinds, keyed on the :class:`quest_tpu.serve.SimServer`
+    STEP index (consumed by the server's per-step hook
+    :meth:`take_serve_fault`, not by run_resumable):
+
+    - ``bank_fault@S`` the bank advanced at (or first after) step S hits
+                      an injected transient fault: the server dissolves
+                      it and its jobs retry in fresh banks
+    - ``heal@S``      the operator heal signal fires at step S
+                      (SimServer.heal(): drain to checkpoint boundaries,
+                      re-expand onto the full mesh)
+    - ``poison_job@J`` job id J is numerically poisoned: NaN is poked
+                      into ITS batch element after every window it runs —
+                      persistent (unlike the one-shot window events), so
+                      the job re-poisons on every retry and the
+                      quarantine bisection converges on it
+    - ``shard_loss@S``/``host_loss@S`` under a server double as
+                      step-keyed infrastructure loss (the server fails
+                      over onto the shrunk mesh)
+
     Every fired event is appended to :attr:`log` so tests can assert the
     plan actually executed."""
 
     _KINDS = ("kill", "killsave", "corrupt", "io", "nan", "inf", "scale",
-              "stall", "shard_loss", "host_loss", "oom")
+              "stall", "shard_loss", "host_loss", "oom",
+              "bank_fault", "heal", "poison_job")
 
     def __init__(self, spec: str = ""):
         self.events: List[Tuple[str, int]] = []
         self.io_budget = 0
+        self.poisoned_jobs: set = set()
         self.log: List[str] = []
         # exchange faults pending for the CURRENT window, armed by
         # run_resumable (arm_exchange_window) and consumed one per
@@ -241,6 +311,8 @@ class FaultPlan:
                 val = int(arg) if arg else 0
                 if kind == "io":
                     self.io_budget += val
+                elif kind == "poison_job":
+                    self.poisoned_jobs.add(val)
                 else:
                     self.events.append((kind, val))
 
@@ -316,6 +388,22 @@ class FaultPlan:
             return True
         return False
 
+    def take_serve_fault(self, step: int) -> Optional[str]:
+        """SimServer's per-step hook: fire at most one serve-level fault
+        keyed on the server's global step index (banks interleave, so a
+        bank-window key would be ambiguous).  Infrastructure loss first —
+        it preempts everything else a step could do."""
+        for kind in ("host_loss", "shard_loss", "bank_fault", "heal"):
+            if self._fire(kind, step):
+                return kind
+        return None
+
+    def poisoned(self, job_id: int) -> bool:
+        """Whether ``poison_job@J`` marks this job id.  Deliberately NOT
+        consumed on read: a poison job must re-poison on every retry or
+        the bisection would exonerate it."""
+        return int(job_id) in self.poisoned_jobs
+
     def take_io_fault(self) -> bool:
         if self.io_budget > 0:
             self.io_budget -= 1
@@ -348,9 +436,10 @@ _HEALTH_FNS: dict = {}
 
 
 def _health_fn():
-    """Jitted health scan: (sum |amps|^2, all-finite flag) in ONE device
-    program — on a sharded register the reductions are GSPMD psums — and
-    one scalar readback for both (the (2,) result array)."""
+    """Jitted health scan: (worst norm, all-finite flag, worst element
+    index) in ONE device program — on a sharded register the reductions
+    are GSPMD psums — and one scalar readback for all three (the (3,)
+    result array)."""
     import jax
     import jax.numpy as jnp
 
@@ -359,17 +448,24 @@ def _health_fn():
         @jax.jit
         def fn(amps):
             if amps.ndim == 3:
-                # a BatchedQureg bank: per-element norms, report the one
-                # FARTHEST from 1 so the watchdog's |norm - 1| verdict
-                # covers every element of the bank
+                # a BatchedQureg bank: per-element norms; a non-finite
+                # element dominates (badness=inf), then the norm FARTHEST
+                # from 1 — argmax names the single worst ELEMENT so the
+                # serving layer can attribute a poisoned bank to one job
                 sq = amps[:, 0] * amps[:, 0] + amps[:, 1] * amps[:, 1]
                 norms = jnp.sum(sq, axis=1)
-                norm = norms[jnp.argmax(jnp.abs(norms - 1.0))]
+                finite_e = jnp.all(jnp.isfinite(amps), axis=(1, 2))
+                badness = jnp.where(finite_e, jnp.abs(norms - 1.0),
+                                    jnp.inf)
+                elem = jnp.argmax(badness)
+                norm = norms[elem]
             else:
                 sq = amps[0] * amps[0] + amps[1] * amps[1]
                 norm = jnp.sum(sq)
+                elem = jnp.zeros((), jnp.int32)
             finite = jnp.all(jnp.isfinite(amps))
-            return jnp.stack([norm, finite.astype(amps.dtype)])
+            return jnp.stack([norm, finite.astype(amps.dtype),
+                              elem.astype(amps.dtype)])
 
         _HEALTH_FNS["fn"] = fn
     return fn
@@ -382,6 +478,14 @@ def check_qureg_health(qureg) -> Tuple[float, bool]:
     are permutation-invariant."""
     out = np.asarray(_health_fn()(qureg._amps_raw()))
     return float(out[0]), bool(out[1])
+
+
+def check_bank_health(qureg) -> Tuple[float, bool, int]:
+    """:func:`check_qureg_health` plus the worst batch-element index —
+    same single device program and readback; the index is 0 for a scalar
+    register."""
+    out = np.asarray(_health_fn()(qureg._amps_raw()))
+    return float(out[0]), bool(out[1]), int(out[2])
 
 
 # watchdog policies; "raise" is the default (fail fast, keep the ckpt)
@@ -519,6 +623,14 @@ def _committed_generations(ckpt_dir: str) -> List[int]:
         if os.path.exists(os.path.join(ckpt_dir, name, _COMMIT)):
             out.append(c)
     return sorted(out, reverse=True)
+
+
+def latest_committed_cursor(ckpt_dir: str) -> Optional[int]:
+    """Cursor of the newest COMMITTED generation under ``ckpt_dir``, or
+    None — the rollback target serve's failover uses to decide whether a
+    live bank can resume from checkpoint or must dissolve and retry."""
+    gens = _committed_generations(os.path.abspath(ckpt_dir))
+    return gens[0] if gens else None
 
 
 def _prune_generations(ckpt_dir: str, keep: int) -> None:
@@ -684,6 +796,9 @@ class WindowExecutor:
         self._boundaries = C.plan_checkpoint_boundaries(
             len(self.gates), self.every, start=self.cursor)
         self._bi = 0
+        # gate range [begin, end) of the most recent step(), for
+        # check_health's fault attribution
+        self.last_window: Optional[Tuple[int, int]] = None
 
     @property
     def done(self) -> bool:
@@ -724,9 +839,34 @@ class WindowExecutor:
                     self.gates[self.cursor:end])
             finally:
                 _fusion.stop_gate_fusion(self.qureg)  # the window pass
+        self.last_window = (self.cursor, end)
         self.cursor = end
         self._bi += 1
         return end
+
+    def check_health(self) -> None:
+        """Numerical-health check at the current window boundary — the
+        fault-surfacing half the serving layer drives (run_resumable has
+        its own policy-bearing watchdog in ``_execute_windows``).  Raises
+        :class:`NumericalHealthError` naming the just-executed gate range
+        and, for a batched bank, the worst element index — the quarantine
+        bisection's direct-attribution fast path."""
+        q = self.qureg
+        norm, finite, elem = check_bank_health(q)
+        # density matrices: purity < 1 is legitimate physics, so only
+        # finiteness is checked (mirrors run_resumable's watchdog)
+        norm_bad = (not q.is_density_matrix
+                    and abs(norm - 1.0) > _health_tolerance(q.dtype))
+        if finite and not norm_bad:
+            return
+        is_bank = getattr(q, "batch_size", 0) > 1
+        desc = ("non-finite amplitudes" if not finite
+                else f"norm {norm!r} drifted beyond tolerance")
+        raise NumericalHealthError(
+            f"health check failed after gates {self.last_window}: {desc}"
+            + (f" (worst element {elem})" if is_bank else ""),
+            window=self.last_window, norm=norm, finite=finite,
+            element=elem if is_bank else None)
 
     def checkpoint(self, ckpt_dir: str) -> str:
         """Commit a generation of the register at the CURRENT cursor (a

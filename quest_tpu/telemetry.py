@@ -603,6 +603,27 @@ def perf_report(env=None) -> str:
             lines.append(
                 f"  queue_wait_seconds: count={tot_n} "
                 f"mean={tot_s / tot_n:.6g} max={wmax:.6g}")
+    # serving resilience (docs/design.md §27): bank retries, poison
+    # quarantine, failover/heal history, and the live degraded flag
+    retr = counter_total("serve_bank_retries_total")
+    quar = counter_total("serve_jobs_quarantined_total")
+    fo = counter_total("serve_failovers_total")
+    heals = counter_total("serve_heals_total")
+    deg = gauge_max("serve_degraded")
+    if retr or quar or fo or heals or deg:
+        by_reason = " ".join(
+            f"{r}={_num(counter_sum('serve_bank_retries_total', reason=r))}"
+            for r in ("transient", "failover", "poison")
+            if counter_sum("serve_bank_retries_total", reason=r))
+        lines.append("serving resilience:")
+        lines.append(f"  bank retries: total={_num(retr)}"
+                     + (f" ({by_reason})" if by_reason else ""))
+        lines.append(
+            f"  quarantined={_num(quar)} failovers={_num(fo)} "
+            f"heals={_num(heals)} degraded={int(deg or 0)}")
+        mttr = gauge_max("serve_failover_mttr_seconds")
+        if mttr is not None:
+            lines.append(f"  failover_mttr_seconds={mttr:.4g}")
     peak = gauge_max("hbm_watermark_bytes")
     if peak is not None:
         lines.append(f"memory: hbm_watermark_bytes peak={_num(peak)} "

@@ -1,0 +1,281 @@
+"""Seeded chaos harness for the serving layer (``make verify-chaos``).
+
+For each seed this builds ONE deterministic open-loop arrival trace
+(multi-tenant, mixed priorities, one NaN-poisoned job) and replays it
+twice through :class:`quest_tpu.serve.SimServer`:
+
+- a **fault-free baseline** run, recording every job's canonical
+  amplitudes, measurement outcomes, and final RNG key state;
+- a **chaos** run under a seed-derived FaultPlan covering an injected
+  bank fault, transient checkpoint-IO failures, a shard/host loss
+  followed by a mesh heal, a synthetic OOM (double-armed on odd seeds to
+  escape the governor's retry and exercise the bisection), and a
+  persistent NaN poison on one job.
+
+The acceptance invariants asserted per seed (docs/design.md §27):
+
+(a) every job completed under chaos is BIT-IDENTICAL to the baseline —
+    amplitudes, outcome/probability pairs, and measurement key state;
+(b) no cross-tenant propagation: the only failed jobs are the poisoned
+    ones (every other tenant's every job completes);
+(c) the server reaches idle within a bounded step count (no deadlock or
+    livelock) with empty queues and no resident banks;
+(d) availability over non-poison jobs is 100%.
+
+Usage: python scripts/chaos_serve.py [--seeds 11,12,37]
+Exits non-zero on any violated invariant; emits one JSON line per seed
+plus an aggregate (chaos_availability_pct, failover MTTR) for
+bench_suite config 15.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("QT_TOPOLOGY", "2x4")
+# the window-stepped serving path suppresses the optimizer; keep both
+# arms on the literal gate stream (bench_serve.py rationale)
+os.environ.setdefault("QT_OPTIMIZER", "off")
+# fast, deterministic backoff so retried jobs return within the bound
+os.environ.setdefault("QT_RETRY_BASE_SECONDS", "0.001")
+os.environ.setdefault("QT_RETRY_ATTEMPTS", "3")
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import circuit as C  # noqa: E402
+from quest_tpu import resilience as R  # noqa: E402
+from quest_tpu import serve as S  # noqa: E402
+from quest_tpu import telemetry as T  # noqa: E402
+
+N = 4           # qubits per job (16 amps >= 8 devices sharded)
+DEPTH = 3       # layers -> 2*N*DEPTH gates per circuit
+WINDOW = 4
+NUM_JOBS = 12
+TENANTS = ("alice", "bob", "carol")
+STEP_BOUND = 2000  # generous: windows + retries + backoff-wait steps
+
+
+def _h(t):
+    m = np.array([[1, 1], [1, -1]], dtype=np.complex128) / np.sqrt(2)
+    return C.Gate((t,), np.stack([m.real, m.imag]))
+
+
+def _rz(t, theta):
+    d = np.exp(1j * np.array([-theta / 2, theta / 2]))
+    return C.Gate((t,), np.stack([np.diag(d.real), np.diag(d.imag)]))
+
+
+def _circ(theta, depth=DEPTH, n=N):
+    gates = []
+    for d in range(depth):
+        for q in range(n):
+            gates.append(_h(q))
+            gates.append(_rz(q, theta + 0.1 * q + d))
+    return gates
+
+
+def _trace(seed):
+    """Deterministic arrival trace: (tenant, theta, priority, measure)
+    per job, in submission order.  One shared circuit STRUCTURE (thetas
+    differ) so arrivals coalesce into banks."""
+    rng = np.random.RandomState(seed)
+    jobs = []
+    for i in range(NUM_JOBS):
+        tenant = TENANTS[int(rng.randint(len(TENANTS)))]
+        theta = float(rng.uniform(0.1, 2.8))
+        prio = S.INTERACTIVE if rng.rand() < 0.25 else S.BATCH
+        jobs.append((tenant, theta, prio, (0, N - 1)))
+    return jobs
+
+
+def _schedule(seed):
+    """The seed-derived fault plan spec.  Every seed covers a transient
+    bank fault, IO faults, infrastructure loss + heal, one poisoned job,
+    and an OOM (double-armed on odd seeds so it escapes the governor's
+    single retry and drives the bisection path)."""
+    rng = np.random.RandomState(seed ^ 0x5EED)
+    loss_kind = "host_loss" if seed % 2 == 0 else "shard_loss"
+    loss_at = int(rng.randint(6, 10))
+    heal_at = loss_at + int(rng.randint(4, 8))
+    oom_at = int(rng.randint(2, 5))
+    parts = [
+        f"bank_fault@{int(rng.randint(2, 6))}",
+        "io@2",
+        f"{loss_kind}@{loss_at}",
+        f"heal@{heal_at}",
+        f"oom@{oom_at}",
+    ]
+    if seed % 2 == 1:
+        parts.append(f"oom@{oom_at}")  # second arm: escape the OOM net
+    poison_jid = int(rng.randint(0, NUM_JOBS))
+    parts.append(f"poison_job@{poison_jid}")
+    return ",".join(parts), {poison_jid}
+
+
+def _run(env, jobs_spec, plan_spec):
+    """Replay one trace; returns {jid: record} plus the server stats."""
+    plan = R.FaultPlan(plan_spec) if plan_spec else None
+    # high breaker threshold: ALL trace jobs share one structure
+    # fingerprint, so an open breaker would reject innocent same-tenant
+    # arrivals at submit() — the open/half-open/closed lifecycle is
+    # pinned by tests/test_serve_resilience.py instead
+    server = S.SimServer(env, window=WINDOW, max_batch=4, retries=4,
+                         watchdog=1,
+                         quarantine=(100, 3600.0), faults=plan)
+    handles = []
+    try:
+        # submit in waves with steps between them: arrivals interleave
+        # with execution (the continuous-batching admission point)
+        for i, (tenant, theta, prio, measure) in enumerate(jobs_spec):
+            handles.append(server.submit(
+                _circ(theta), num_qubits=N, tenant=tenant,
+                priority=prio, measure=measure))
+            if i % 3 == 2:
+                for _ in range(2):
+                    server.step()
+        steps = server.run_until_idle(max_steps=STEP_BOUND)
+        stats = server.stats()
+        out = {}
+        for h in handles:
+            out[h.id] = {
+                "tenant": h.tenant,
+                "state": h.state,
+                "attempts": h.attempts,
+                "amps": None if h.amps is None
+                else np.asarray(h.amps).tobytes(),
+                "outcomes": tuple(h.outcomes),
+                "key": None if h.key_state is None
+                else (np.asarray(h.key_state["key"]).tobytes(),
+                      int(h.key_state["counter"])),
+            }
+        return out, stats, steps, plan
+    finally:
+        server.close()
+
+
+def run_seed(seed):
+    """One seed's A/B replay + invariant checks; returns the record."""
+    R.seed_backoff_jitter([seed])
+    env = qt.createQuESTEnv()
+    qt.seedQuEST(env, [seed])
+    base, base_stats, base_steps, _ = _run(env, _trace(seed), "")
+
+    R.seed_backoff_jitter([seed])
+    env = qt.createQuESTEnv()
+    qt.seedQuEST(env, [seed])
+    plan_spec, poisoned = _schedule(seed)
+    chaos, stats, steps, plan = _run(env, _trace(seed), plan_spec)
+
+    violations = []
+    # (c) bounded idle: run_until_idle returned because nothing was
+    # runnable, not because it hit the bound
+    if steps >= STEP_BOUND:
+        violations.append(f"step bound hit ({steps})")
+    if stats["queued"] or stats["banks"]:
+        violations.append(
+            f"not idle: queued={stats['queued']} banks={stats['banks']}")
+    # (b)+(d): only poisoned jobs may fail; everything else completes
+    failed = {j for j, rec in chaos.items() if rec["state"] != "done"}
+    if not failed <= poisoned:
+        violations.append(
+            f"non-poison failures: {sorted(failed - poisoned)}")
+    non_poison = [j for j in chaos if j not in poisoned]
+    completed = [j for j in non_poison if chaos[j]["state"] == "done"]
+    availability = 100.0 * len(completed) / max(1, len(non_poison))
+    if availability < 100.0:
+        violations.append(f"availability {availability:.1f}% < 100%")
+    # cross-tenant isolation, stated directly: every tenant that owns no
+    # poisoned job has ALL of its jobs completed
+    poison_tenants = {chaos[j]["tenant"] for j in poisoned if j in chaos}
+    for j, rec in chaos.items():
+        if rec["tenant"] not in poison_tenants \
+                and rec["state"] != "done":
+            violations.append(
+                f"tenant {rec['tenant']} (no poison) lost job {j}")
+    # (a) bit-identity of every completed job vs the fault-free run
+    identical = 0
+    for j in completed:
+        b, c = base[j], chaos[j]
+        if (b["amps"] == c["amps"] and b["outcomes"] == c["outcomes"]
+                and b["key"] == c["key"]):
+            identical += 1
+        else:
+            violations.append(f"job {j} diverged from fault-free run")
+    # the plan must actually have fired (log covers each armed kind)
+    fired = {e.split("@")[0] for e in plan.log}
+    for kind in ("bank_fault", "heal", "poison_job"):
+        if kind not in fired:
+            violations.append(f"armed {kind} never fired (log={plan.log})")
+
+    return {
+        "seed": seed,
+        "plan": plan_spec,
+        "violations": violations,
+        "availability_pct": availability,
+        "completed": len(completed),
+        "non_poison": len(non_poison),
+        "bit_identical": identical,
+        "quarantined": sorted(failed & poisoned),
+        "steps": steps,
+        "baseline_steps": base_steps,
+        "devices_after": stats["devices"],
+        "degraded_after": stats["degraded"],
+    }
+
+
+def run(seeds=(11, 12, 37)):
+    """Entry point shared with bench_suite config 15."""
+    t0 = time.perf_counter()
+    records = []
+    ok = True
+    for seed in seeds:
+        rec = run_seed(int(seed))
+        records.append(rec)
+        ok = ok and not rec["violations"]
+        print(json.dumps(rec))
+    mttr = T.gauge_max("serve_failover_mttr_seconds")
+    agg = {
+        "seeds": list(map(int, seeds)),
+        "ok": ok,
+        "availability_pct": min(r["availability_pct"] for r in records),
+        "bit_identical": sum(r["bit_identical"] for r in records),
+        "completed": sum(r["completed"] for r in records),
+        "failover_mttr_seconds": None if mttr is None else float(mttr),
+        "failovers": int(T.counter_total("serve_failovers_total")),
+        "heals": int(T.counter_total("serve_heals_total")),
+        "bank_retries": int(T.counter_total("serve_bank_retries_total")),
+        "quarantined": int(
+            T.counter_total("serve_jobs_quarantined_total")),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    print(json.dumps({"aggregate": agg}))
+    return agg
+
+
+def main():
+    raw = "11,12,37"
+    if "--seeds" in sys.argv:
+        raw = sys.argv[sys.argv.index("--seeds") + 1]
+    agg = run(tuple(int(s) for s in raw.split(",")))
+    if not agg["ok"]:
+        print("chaos_serve: INVARIANT VIOLATED", file=sys.stderr)
+        return 1
+    print(f"chaos_serve: OK — availability={agg['availability_pct']:.1f}% "
+          f"bit_identical={agg['bit_identical']} "
+          f"failovers={agg['failovers']} heals={agg['heals']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

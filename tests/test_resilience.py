@@ -394,7 +394,7 @@ class TestFaultPlanParsing:
 
     def test_unknown_kind_rejected(self):
         with pytest.raises(qt.QuESTError, match="unknown fault kind"):
-            qt.FaultPlan("meteor@3")
+            qt.FaultPlan("meteor@3")  # qlint: allow(fault-plan-spec): deliberately unknown kind — the test pins the rejection path
 
     def test_exchange_fault_kinds_parse(self):
         plan = qt.FaultPlan("stall@2, shard_loss@3")

@@ -1,0 +1,447 @@
+"""Serving-layer fault tolerance (docs/design.md §27).
+
+Covers the PR's contracts:
+
+- decorrelated-jitter backoff: the quest_tpu-RNG-seeded stream obeys
+  the [base, min(64*base, 3*prev)] envelope, reproduces bit-identically
+  under ``seed_backoff_jitter``, and replaces retry_io's deterministic
+  1-2-4 ladder;
+- failure isolation + job-level retry: a transient bank fault dissolves
+  the bank (never fails the job), members retry in fresh banks, and a
+  job completed under retry is BIT-IDENTICAL to its fault-free run —
+  amplitudes, measurement outcomes, and key state (the pinned test);
+- retry exhaustion: jobs past their budget fail with a per-job
+  :class:`JobFailedError` carrying tenant/id/attempts/cause, surfaced
+  identically by ``Job.result()`` and the async ``Service.wait``;
+- poison-job quarantine: the watchdog's worst-element attribution on a
+  batched bank bisects straight to the culprit (bank-mates complete
+  bit-identically, free of retry charge), repeated OOM halves blindly,
+  and the per-(tenant, structure) circuit breaker walks
+  open -> half-open -> closed;
+- elastic degraded-mode failover + mesh heal: host loss mid-run shrinks
+  the serving mesh without dropping queued work, ``heal()`` re-expands
+  onto the full mesh, and everything still completes bit-identically;
+- the qlint fault-vocabulary pin: analysis.rules_trace's
+  FaultPlanSpecRule.KINDS must track resilience.FaultPlan._KINDS.
+"""
+
+import ast
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as C
+from quest_tpu import resilience as R
+from quest_tpu import serve as S
+from quest_tpu import telemetry as T
+
+N = 4
+
+
+@pytest.fixture(autouse=True)
+def raw_stream(monkeypatch):
+    """Window-stepped serving always runs with the optimizer suppressed;
+    baselines here must be raw too (tests/test_serve.py rationale)."""
+    monkeypatch.setenv("QT_OPTIMIZER", "off")
+    from quest_tpu import optimizer as _opt
+    _opt.clear_cache()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def fast_seeded_backoff(monkeypatch):
+    """Millisecond backoff so retries finish inside the step bounds, and
+    a pinned jitter stream so every test run draws the same delays."""
+    monkeypatch.setenv(R._RETRY_BASE_ENV, "0.001")
+    R.seed_backoff_jitter([20260805])
+    yield
+    R._JITTER_RNG[0] = None
+
+
+def _h(t):
+    m = np.array([[1.0, 1.0], [1.0, -1.0]]) / np.sqrt(2.0)
+    return C.Gate((t,), np.stack([m, np.zeros((2, 2))]))
+
+
+def _rz(t, theta):
+    d = np.exp(1j * np.array([-theta / 2, theta / 2]))
+    return C.Gate((t,), np.stack([np.diag(d.real), np.diag(d.imag)]))
+
+
+def _circ(theta, depth=3, n=N):
+    gates = []
+    for d in range(depth):
+        for q in range(n):
+            gates.append(_h(q))
+            gates.append(_rz(q, theta + 0.1 * q + d))
+    return gates
+
+
+def _snapshot(job):
+    return {
+        "amps": np.asarray(job.amps).tobytes(),
+        "outcomes": tuple(job.outcomes),
+        "key": np.asarray(job.key_state["key"]).tobytes(),
+        "counter": int(job.key_state["counter"]),
+    }
+
+
+def _run_trace(env, thetas, *, faults=None, measure=(0, 2), **kw):
+    """Submit one deterministic trace and drain it; returns the jobs."""
+    srv = S.SimServer(env, window=4, max_batch=8, faults=faults, **kw)
+    try:
+        jobs = [srv.submit(_circ(t), num_qubits=N, seed=100 + i,
+                           measure=measure)
+                for i, t in enumerate(thetas)]
+        srv.run_until_idle(max_steps=800)
+        return jobs, srv.stats()
+    finally:
+        srv.close()
+
+
+class TestBackoffJitter:
+    def test_envelope(self):
+        base = 0.01
+        prev = None
+        for _ in range(50):
+            d = R.backoff_delay(base, prev)
+            lo, hi = base, max(base, min(64 * base,
+                                         3 * (prev or base)))
+            assert lo <= d <= hi
+            prev = d
+
+    def test_cap_at_64x_base(self):
+        base = 0.01
+        d = base
+        for _ in range(100):
+            d = R.backoff_delay(base, d)
+            assert d <= 64 * base
+
+    def test_deterministic_under_seed(self):
+        R.seed_backoff_jitter([7])
+        a = [R.backoff_delay(0.01, None) for _ in range(10)]
+        R.seed_backoff_jitter([7])
+        b = [R.backoff_delay(0.01, None) for _ in range(10)]
+        R.seed_backoff_jitter([8])
+        c = [R.backoff_delay(0.01, None) for _ in range(10)]
+        assert a == b
+        assert a != c
+
+    def test_chaos_seed_env_pins_stream(self, monkeypatch):
+        monkeypatch.setenv(R._CHAOS_SEED_ENV, "424242")
+        R.seed_backoff_jitter()
+        a = [R.backoff_delay(0.01, None) for _ in range(5)]
+        R.seed_backoff_jitter()
+        assert a == [R.backoff_delay(0.01, None) for _ in range(5)]
+
+    def test_jitter_stream_is_not_the_measurement_stream(self):
+        from quest_tpu import rng as _rng
+        R.backoff_delay(0.01, None)
+        assert R._JITTER_RNG[0] is not None
+        assert R._JITTER_RNG[0] is not _rng.GLOBAL_RNG
+
+    def test_retry_io_sleeps_jittered_not_ladder(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr(R.time, "sleep", sleeps.append)
+        R._ACTIVE_FAULTS[0] = R.FaultPlan("io@3")
+        try:
+            R.seed_backoff_jitter([99])
+            out = R.retry_io(lambda: "ok", attempts=4,
+                             base_delay=0.001)
+        finally:
+            R._ACTIVE_FAULTS[0] = None
+        assert out == "ok"
+        assert len(sleeps) == 3
+        # NOT the old deterministic 1-2-4 ladder...
+        assert sleeps != [0.001, 0.002, 0.004]
+        # ...but inside its bounded envelope, and reproducible
+        prev = None
+        for d in sleeps:
+            assert 0.001 <= d <= max(0.001, 3 * (prev or 0.001))
+            prev = d
+        replay = []
+        monkeypatch.setattr(R.time, "sleep", replay.append)
+        R._ACTIVE_FAULTS[0] = R.FaultPlan("io@3")
+        try:
+            R.seed_backoff_jitter([99])
+            R.retry_io(lambda: "ok", attempts=4, base_delay=0.001)
+        finally:
+            R._ACTIVE_FAULTS[0] = None
+        assert replay == sleeps
+
+
+class TestTransientRetry:
+    THETAS = (0.3, 0.45, 0.6)
+
+    def test_bank_fault_dissolves_and_completes_bit_identical(self, env):
+        baseline, _ = _run_trace(env, self.THETAS)
+        T.reset()
+        jobs, stats = _run_trace(
+            env, self.THETAS, faults=R.FaultPlan("bank_fault@1"))
+        assert T.counter_sum("serve_bank_retries_total",
+                             reason="transient") >= 1
+        for b, j in zip(baseline, jobs):
+            assert j.state == S.DONE
+            assert j.attempts == 2          # one fault, one clean rerun
+            assert j.errors and "injected bank fault" in j.errors[0]
+            assert _snapshot(j) == _snapshot(b)
+        assert stats["queued"] == 0 and stats["banks"] == 0
+
+    def test_retry_gated_behind_backoff(self, env):
+        jobs, _ = _run_trace(env, self.THETAS,
+                             faults=R.FaultPlan("bank_fault@1"))
+        assert all(j.backoff is not None and j.backoff >= 0.001
+                   for j in jobs)
+
+    def test_exhaustion_fails_with_error_chain(self, env):
+        jobs, stats = _run_trace(env, (0.3,), retries=0,
+                                 faults=R.FaultPlan("bank_fault@1"))
+        (job,) = jobs
+        assert job.state == S.FAILED
+        assert job.attempts == 1
+        assert len(job.errors) == 1
+        with pytest.raises(S.JobFailedError) as ei:
+            job.result()
+        err = ei.value
+        assert err.tenant == "default" and err.jid == job.id
+        assert err.attempts == 1
+        assert isinstance(err.cause, TimeoutError)
+        # each result() call wraps fresh — per-job, never a shared raise
+        with pytest.raises(S.JobFailedError) as ei2:
+            job.result()
+        assert ei2.value is not err and ei2.value.cause is err.cause
+        assert stats["queued"] == 0
+
+    def test_service_wait_raises_jobfailederror(self, env):
+        async def main():
+            srv = S.SimServer(env, window=4, max_batch=8, retries=0,
+                              faults=R.FaultPlan("bank_fault@1"))
+            try:
+                async with S.Service(srv, idle_sleep=0.0005) as svc:
+                    job = await svc.submit(_circ(0.3), num_qubits=N)
+                    with pytest.raises(S.JobFailedError) as ei:
+                        await svc.wait(job)
+                    return ei.value
+            finally:
+                srv.close()
+
+        err = asyncio.run(main())
+        assert isinstance(err.cause, TimeoutError)
+
+
+class TestPoisonQuarantine:
+    THETAS = (0.2, 0.35, 0.5, 0.65)
+
+    def test_worst_element_attribution_quarantines_culprit(self, env):
+        baseline, _ = _run_trace(env, self.THETAS, watchdog=1)
+        # job ids are per-server: the same trace reuses the same ids
+        poison_id = baseline[2].id
+        T.reset()
+        jobs, stats = _run_trace(
+            env, self.THETAS, watchdog=1,
+            faults=R.FaultPlan(f"poison_job@{poison_id}"))
+        assert jobs[2].id == poison_id
+        # the culprit bisected straight to a singleton and quarantined
+        assert jobs[2].state == S.FAILED
+        with pytest.raises(S.JobFailedError) as ei:
+            jobs[2].result()
+        assert isinstance(ei.value.cause, R.NumericalHealthError)
+        # bank-mates completed BIT-IDENTICALLY, uncharged by the poison
+        for k in (0, 1, 3):
+            assert jobs[k].state == S.DONE
+            assert _snapshot(jobs[k]) == _snapshot(baseline[k])
+        assert T.counter_sum("serve_jobs_quarantined_total",
+                             tenant="default") == 1
+        assert T.counter_sum("serve_bank_retries_total",
+                             reason="poison") >= 1
+        assert stats["queued"] == 0 and stats["banks"] == 0
+
+    def test_health_error_carries_worst_element(self, env):
+        from quest_tpu import batch as B
+        q = B.createBatchedQureg(N, env, 4, seeds=[1, 2, 3, 4])
+        amps = q._amps_raw()
+        amps = amps.at[2, 0, 3].set(np.nan)
+        q._set_amps_permuted(amps, q._perm)
+        norm, finite, elem = R.check_bank_health(q)
+        assert not finite and elem == 2
+
+    def test_repeated_oom_bisects_blind_and_all_complete(self, env):
+        baseline, _ = _run_trace(env, self.THETAS)
+        T.reset()
+        # two armed events burn the governor net's single retry: the
+        # verdict is repeated-OOM with no element attribution -> halve
+        jobs, stats = _run_trace(env, self.THETAS,
+                                 faults=R.FaultPlan("oom@1,oom@1"))
+        for b, j in zip(baseline, jobs):
+            assert j.state == S.DONE
+            assert _snapshot(j) == _snapshot(b)
+        assert T.counter_sum("serve_bank_retries_total",
+                             reason="poison") >= 1
+        assert stats["queued"] == 0 and stats["banks"] == 0
+
+    def test_breaker_lifecycle_unit(self):
+        br = S._Breaker(2, 30.0)
+        assert br.admits() and br.state == "closed"
+        br.record_failure()
+        assert br.admits()
+        br.record_failure()
+        assert br.state == "open" and not br.admits()
+        br.open_seconds = 0.0
+        assert br.admits()              # the half-open probe slot
+        assert br.state == "half_open"
+        assert not br.admits()          # only ONE probe at a time
+        br.record_success()
+        assert br.state == "closed" and br.admits()
+        # a half-open probe that fails re-opens immediately
+        br.record_failure()
+        br.record_failure()
+        br.open_seconds = 0.0
+        assert br.admits()
+        br.record_failure()
+        assert br.state == "open"
+
+    def test_quarantine_opens_breaker_per_tenant_structure(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8, watchdog=1,
+                          quarantine=(1, 3600.0))
+        try:
+            bad = srv.submit(_circ(0.4), num_qubits=N, tenant="eve")
+            srv.faults = R.FaultPlan(f"poison_job@{bad.id}")
+            srv.run_until_idle(max_steps=400)
+            assert bad.state == S.FAILED
+            # same tenant + structure: breaker is OPEN -> rejected
+            with pytest.raises(S.QuotaExceededError) as ei:
+                srv.submit(_circ(0.4), num_qubits=N, tenant="eve")
+            assert ei.value.kind == "quarantine"
+            # another tenant's identical structure is unaffected
+            ok = srv.submit(_circ(0.4), num_qubits=N, tenant="bob")
+            # a DIFFERENT structure from the quarantined tenant too
+            ok2 = srv.submit(_circ(0.4, depth=1), num_qubits=N,
+                             tenant="eve")
+            srv.faults = None
+            srv.run_until_idle(max_steps=400)
+            assert ok.state == S.DONE and ok2.state == S.DONE
+            # after open_seconds the breaker half-opens: one probe
+            # admitted, and its completion closes the breaker
+            (br,) = [b for (t, _k), b in srv._breakers.items()
+                     if t == "eve"]
+            br.open_seconds = 0.0
+            probe = srv.submit(_circ(0.4), num_qubits=N, tenant="eve")
+            srv.run_until_idle(max_steps=400)
+            assert probe.state == S.DONE
+            assert br.state == "closed"
+        finally:
+            srv.close()
+
+
+def _assert_same_result(job, base):
+    """Degraded-mesh completion check: this suite runs at precision 2
+    (conftest), where the sharded BATCHED einsum's reduction order — and
+    so the last ulp — depends on the device count, so a job that ran
+    windows on the shrunk mesh is compared to within that drift.  The
+    strict cross-mesh bit-identity pin for the full failover/heal
+    drain-and-regrow path is the chaos harness (scripts/chaos_serve.py,
+    default precision, where the batched path IS bit-identical across
+    mesh shapes)."""
+    assert np.allclose(np.asarray(job.amps), np.asarray(base.amps),
+                       rtol=0.0, atol=1e-13)
+    assert [o for o, _p in job.outcomes] == [o for o, _p in
+                                             base.outcomes]
+    assert np.allclose([p for _o, p in job.outcomes],
+                       [p for _o, p in base.outcomes],
+                       rtol=0.0, atol=1e-13)
+
+
+class TestFailoverHeal:
+    THETAS = (0.25, 0.4, 0.55, 0.7, 0.85)
+
+    def test_host_loss_then_heal_all_complete(self, env):
+        baseline, _ = _run_trace(env, self.THETAS)
+        T.reset()
+        jobs, stats = _run_trace(
+            env, self.THETAS,
+            faults=R.FaultPlan("host_loss@3,heal@6"))
+        for b, j in zip(baseline, jobs):
+            assert j.state == S.DONE
+            _assert_same_result(j, b)
+        # healed back onto the full mesh, not still degraded
+        assert not stats["degraded"]
+        assert stats["devices"] == env.num_devices
+        assert T.counter_total("serve_failovers_total") == 1
+        assert T.counter_total("serve_heals_total") == 1
+        assert T.gauge_max("serve_degraded") == 0.0
+        assert T.gauge_max("serve_failover_mttr_seconds") is not None
+
+    def test_post_heal_results_bit_identical(self, env):
+        """The pinned heal contract: once healed, serving is back at
+        full fidelity — jobs run on the healed mesh are BIT-IDENTICAL
+        to the fault-free run, not merely close."""
+        baseline, _ = _run_trace(env, self.THETAS)
+        srv = S.SimServer(env, window=4, max_batch=8,
+                          faults=R.FaultPlan("host_loss@0,heal@1"))
+        try:
+            # the loss and the heal both fire while the queue is empty
+            for _ in range(2):
+                srv.step()
+            assert srv.stats()["devices"] == env.num_devices
+            assert not srv.stats()["degraded"]
+            jobs = [srv.submit(_circ(t), num_qubits=N, seed=100 + i,
+                               measure=(0, 2))
+                    for i, t in enumerate(self.THETAS)]
+            srv.run_until_idle(max_steps=800)
+        finally:
+            srv.close()
+        for b, j in zip(baseline, jobs):
+            assert j.state == S.DONE
+            assert _snapshot(j) == _snapshot(b)
+
+    def test_degraded_serving_without_heal_still_completes(self, env):
+        baseline, _ = _run_trace(env, self.THETAS)
+        T.reset()
+        jobs, stats = _run_trace(env, self.THETAS,
+                                 faults=R.FaultPlan("shard_loss@2"))
+        for b, j in zip(baseline, jobs):
+            assert j.state == S.DONE
+            _assert_same_result(j, b)
+        # still on the shrunk mesh: degraded is VISIBLE, not silent
+        assert stats["degraded"]
+        assert stats["devices"] == env.num_devices // 2
+        assert T.gauge_max("serve_degraded") == 1.0
+
+    def test_heal_is_idempotent_when_not_degraded(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8)
+        try:
+            assert srv.heal() is False
+        finally:
+            srv.close()
+
+    def test_failover_reprices_admission_on_live_env(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8,
+                          faults=R.FaultPlan("shard_loss@1"))
+        try:
+            before = S._job_bytes_per_device(N, srv.env, False)
+            srv.submit(_circ(0.3), num_qubits=N)
+            srv.run_until_idle(max_steps=400)
+            after = S._job_bytes_per_device(N, srv.env, False)
+            # half the devices -> each holds twice the bytes
+            assert after == 2 * before
+        finally:
+            srv.close()
+
+
+class TestLintFaultVocabulary:
+    def test_rule_kinds_track_faultplan(self):
+        from quest_tpu.analysis import rules_trace as RT
+        assert set(RT.FaultPlanSpecRule.KINDS) == set(R.FaultPlan._KINDS)
+
+    def test_rule_flags_unknown_kind(self):
+        from quest_tpu.analysis import rules_trace as RT
+        rule = RT.FaultPlanSpecRule()
+        src = "plan = FaultPlan('kill@2,bogus@3')\n"
+        findings = list(rule.check(ast.parse(src), src, "quest_tpu/x.py"))
+        assert any("bogus" in f.message for f in findings)
+        clean = "plan = FaultPlan('bank_fault@2,poison_job@1')\n"
+        assert not list(rule.check(ast.parse(clean), clean,
+                                   "quest_tpu/x.py"))

@@ -614,3 +614,52 @@ class TestResilienceHooks:
                          str(tmp_path / "ck"), every=1, faults=plan)
         assert T.counter_total("checkpoint_io_retries_total") == 2
         assert plan.log == ["io", "io"]
+
+
+class TestServingResilienceSeries:
+    """The serving fault-tolerance series names are operator contract
+    (dashboards and alerts key on them) — pinned against the exposition
+    byte-for-byte, plus the perf_report "serving resilience" block."""
+
+    def _record(self):
+        T.inc("serve_bank_retries_total", 2, reason="transient")
+        T.inc("serve_bank_retries_total", reason="failover")
+        T.inc("serve_bank_retries_total", reason="poison")
+        T.inc("serve_jobs_quarantined_total", tenant="acme")
+        T.inc("serve_failovers_total")
+        T.inc("serve_heals_total")
+        T.set_gauge("serve_degraded", 1.0)
+        T.set_gauge("serve_failover_mttr_seconds", 0.025)
+
+    def test_pinned_prometheus_names(self):
+        self._record()
+        text = T.prometheus_text()
+        assert 'serve_bank_retries_total{reason="transient"} 2' in text
+        assert 'serve_bank_retries_total{reason="failover"} 1' in text
+        assert 'serve_bank_retries_total{reason="poison"} 1' in text
+        assert 'serve_jobs_quarantined_total{tenant="acme"} 1' in text
+        assert "\nserve_failovers_total 1" in text
+        assert "\nserve_heals_total 1" in text
+        assert "\nserve_degraded 1" in text
+        assert "\nserve_failover_mttr_seconds 0.025" in text
+
+    def test_perf_report_serving_resilience_block(self):
+        self._record()
+        report = T.perf_report()
+        assert "serving resilience:" in report
+        assert "bank retries: total=4 " \
+               "(transient=2 failover=1 poison=1)" in report
+        assert "quarantined=1 failovers=1 heals=1 degraded=1" in report
+        assert "failover_mttr_seconds=0.025" in report
+
+    def test_block_absent_when_no_faults(self):
+        T.inc("serve_jobs_submitted_total", tenant="acme")
+        assert "serving resilience:" not in T.perf_report()
+
+    def test_environment_string_serve_fragment(self, env):
+        from quest_tpu.env import get_environment_string
+        assert "Serve=" not in get_environment_string(env)
+        self._record()
+        s = get_environment_string(env)
+        assert "Serve=retries:4,quarantined:1,failovers:1," \
+               "heals:1,degraded:1" in s
