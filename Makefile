@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-regress bench docs clean
 
 all: native
 
@@ -63,9 +63,20 @@ verify-chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 QT_TOPOLOGY=2x4 python scripts/chaos_serve.py
 
+# Permutation fast paths + sparse state prep (docs/design.md §28): the
+# parity/fold/admission contract suite plus the QT_PERM_FAST on/off A/B
+# — amplitude parity on every workload, model_drift_total == 0 in both
+# arms, the relabel-only stream pinned to zero window exchanges AND
+# zero compiled collectives on its canonical read, and >= 5x wall-clock
+# over the dense baseline.  The headline speedups join the regression
+# trajectory as bench_suite config 16.
+verify-sparse:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_permfast.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_sparse.py
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static verify-serve verify-optimizer verify-chaos
+verify: verify-static verify-serve verify-optimizer verify-chaos verify-sparse
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

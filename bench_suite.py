@@ -753,10 +753,50 @@ def config15():
            "seeds": rec["seeds"]})
 
 
+def config16():
+    """Permutation fast paths + sparse state prep (ISSUE 15):
+    QT_PERM_FAST=on vs off on a ripple-carry-adder-style CNOT/Toffoli
+    chain, a relabel-only SWAP churn, and sparse clustered-state
+    preparation (scripts/bench_sparse.py, arXiv:2504.08705).  Two
+    timing lines: the permutation wall-clock speedup and the
+    sparse-init speedup, each with the parity/drift/zero-collective
+    checks in tow."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_sparse
+
+    t0 = time.perf_counter()
+    try:
+        rec = bench_sparse.run(n=16 if CPU else 26,
+                               depth=60 if CPU else 100)
+    except RuntimeError as e:
+        _emit(16, f"perm fast-path A/B (SKIPPED: {e})", 0.0,
+              "perm_speedup_x", 0.0)
+        return
+    _set_compile(0.0)  # both arms warm inside run()
+    seconds = round(time.perf_counter() - t0, 3)
+    w = rec["workloads"]
+    _emit(16, f"{rec['n']}q permutation-lowering wall-clock speedup",
+          rec["perm_speedup_x"], "perm_speedup_x", seconds,
+          {name: {"speedup_x": w[name]["speedup_x"],
+                  "max_abs_err": w[name]["max_abs_err"],
+                  "drift": w[name]["on"]["drift"]
+                  + w[name]["off"]["drift"]}
+           for name in ("relabel", "ripple")}
+          | {"relabel_read_collectives":
+             sum(w["relabel"]["read_collectives"].values()),
+             "relabel_window_exchanges":
+             w["relabel"]["on"]["window_remap_exchanges"]})
+    _emit(16, f"{rec['n']}q sparse clustered-state init speedup",
+          rec["sparse_init_speedup_x"], "sparse_init_speedup_x", seconds,
+          {"nonzeros": w["sparse"]["sparse"]["nonzeros"],
+           "max_abs_err": w["sparse"]["max_abs_err"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15}
+           15: config15, 16: config16}
 
 
 def main():

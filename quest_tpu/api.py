@@ -51,6 +51,7 @@ _K_DIAG = _telemetry.counter_key("dispatch_total", family="diag")
 _K_NOT = _telemetry.counter_key("dispatch_total", family="not")
 _K_PARITY = _telemetry.counter_key("dispatch_total", family="parity_phase")
 _K_SWAP = _telemetry.counter_key("dispatch_total", family="swap")
+_K_PERM = _telemetry.counter_key("dispatch_total", family="permutation")
 # bitEncoding (QuEST.h:269)
 UNSIGNED, TWOS_COMPLEMENT = 0, 1
 
@@ -453,6 +454,74 @@ def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
     V.validate_finite(re, "initStateFromAmps")
     V.validate_finite(im, "initStateFromAmps")
     qureg.amps = qureg.device_put(np.stack([re, im]))
+
+
+def initSparseState(qureg: Qureg, indices, amps) -> None:
+    """Initialise from a SPARSE amplitude list: ``state[indices[k]] =
+    amps[k]``, every other amplitude zero (docs/design.md §28; sparse
+    state preparation per arXiv:2504.08705).  State-vectors only.
+
+    The register is admitted under the governor at SPARSE cost — the
+    indices + values, not the dense 2^n footprint — and densifies
+    lazily on the first touch under admission control
+    (governor.admit_sparse_state), so a budget too tight for the dense
+    state today still accepts the description and makes room when the
+    first drain arrives.  On an ungoverned scalar register the dense
+    state scatters directly on device (kernels.init_sparse_state) —
+    either route produces bit-identical amplitudes."""
+    from . import governor as _governor
+
+    V.validate_state_vector(qureg, "initSparseState")
+    _guard_batched_eager(qureg, "initSparseState")
+    idx = np.asarray(indices, dtype=np.int64).ravel()
+    vals = np.asarray(amps, dtype=np.complex128).ravel()
+    if idx.size == 0 or idx.size != vals.size:
+        raise V.QuESTError(
+            "initSparseState: indices and amps must be non-empty and "
+            "equal length.")
+    if int(idx.min()) < 0 or int(idx.max()) >= qureg.num_amps_total:
+        raise V.QuESTError("initSparseState: Invalid amplitude index.")
+    if np.unique(idx).size != idx.size:
+        raise V.QuESTError("initSparseState: duplicate amplitude indices.")
+    V.validate_finite(vals.real, "initSparseState")
+    V.validate_finite(vals.imag, "initSparseState")
+    _telemetry.inc_key(_K_PERM, _bw(qureg))
+    _telemetry.inc("sparse_inits_total")
+    _telemetry.inc("sparse_init_amps_total", int(idx.size))
+    # a wholesale init makes pending fused gates unobservable — drop
+    # them like the amps setter does
+    if qureg._fusion is not None and qureg._fusion.gates:
+        qureg._fusion.gates.clear()
+    if not _governor.enabled() and not _fusion._shard_bits(qureg):
+        qureg.amps = qureg.device_put(K.init_sparse_state(
+            qureg.num_amps_total, idx, vals.real, vals.imag, qureg.dtype))
+    else:
+        _governor.admit_sparse_state(qureg, idx, vals.real, vals.imag)
+
+
+def initSparseClusteredState(qureg: Qureg, bases, blocks) -> None:
+    """Initialise a sparse CLUSTERED state (arXiv:2504.08705): the
+    nonzero amplitudes sit in contiguous blocks, ``state[bases[c] + k] =
+    blocks[c][k]`` — the structured-sparsity workload class bench
+    config 16 exercises.  Expands the blocks to a flat sparse list and
+    delegates to :func:`initSparseState` (same admission semantics)."""
+    bl = list(blocks)
+    bs = np.asarray(bases, dtype=np.int64).ravel()
+    if bs.size == 0 or bs.size != len(bl):
+        raise V.QuESTError(
+            "initSparseClusteredState: bases and blocks must be "
+            "non-empty and equal length.")
+    idx_parts = []
+    val_parts = []
+    for base, block in zip(bs, bl):
+        v = np.asarray(block, dtype=np.complex128).ravel()
+        if v.size == 0:
+            raise V.QuESTError(
+                "initSparseClusteredState: empty amplitude block.")
+        idx_parts.append(int(base) + np.arange(v.size, dtype=np.int64))
+        val_parts.append(v)
+    initSparseState(qureg, np.concatenate(idx_parts),
+                    np.concatenate(val_parts))
 
 
 def setAmps(qureg: Qureg, startInd: int, reals, imags, numAmps: int) -> None:
@@ -967,12 +1036,33 @@ def swapGate(qureg: Qureg, qubit1: int, qubit2: int) -> None:
         qureg.qasm_log.gate("swap", (qubit1,), qubit2)
         return
     _guard_batched_eager(qureg, "swapGate")
-    qureg.amps = K.swap_qubit_amps(qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
-    if qureg.is_density_matrix:
-        sh = _shift(qureg)
+    from . import circuit as _circ
+
+    if _circ.perm_fast_enabled():
+        # §28 relabel route: ONE transpose-shaped index relabel
+        # (kernels.permute_qubits) instead of swap_qubit_amps' matmul
+        # pass — covers ket and bra bits in the same kernel
+        n = _sv_n(qureg)
+        perm = list(range(n))
+        pairs = [(qubit1, qubit2)]
+        if qureg.is_density_matrix:
+            sh = _shift(qureg)
+            pairs.append((qubit1 + sh, qubit2 + sh))
+        for a, b in pairs:
+            perm[a], perm[b] = perm[b], perm[a]
+        _telemetry.inc_key(_K_PERM, _bw(qureg))
+        _telemetry.inc("permutation_gates_total", route="relabel")
+        qureg.amps = K.permute_qubits(
+            qureg.amps, num_qubits=n, perm=tuple(perm))
+    else:
         qureg.amps = K.swap_qubit_amps(
-            qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1 + sh, qb2=qubit2 + sh
-        )
+            qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1, qb2=qubit2)
+        if qureg.is_density_matrix:
+            sh = _shift(qureg)
+            qureg.amps = K.swap_qubit_amps(
+                qureg.amps, num_qubits=_sv_n(qureg), qb1=qubit1 + sh,
+                qb2=qubit2 + sh
+            )
     qureg.qasm_log.gate("swap", (qubit1,), qubit2)
 
 

@@ -105,6 +105,203 @@ def controlled_dense(mat_soa, num_controls: int, control_states=()):
 
 
 # ---------------------------------------------------------------------------
+# Permutation gate family: classification + gather-shaped lowering
+# (docs/design.md §28)
+# ---------------------------------------------------------------------------
+
+# Composed gather tables are 2^|union| entries: past this width a run is
+# split into several gather passes instead of one giant index table.
+PERM_GATHER_MAX_BITS = 10
+
+
+def perm_fast_enabled() -> bool:
+    """QT_PERM_FAST gate for the permutation fast paths (default ON; any
+    of off/0/false/no disables, rerouting the family through the dense
+    matmul pipeline — the A/B baseline scripts/bench_sparse.py times)."""
+    import os
+
+    raw = os.environ.get("QT_PERM_FAST", "").strip().lower()
+    return raw not in ("off", "0", "false", "no")
+
+
+def _classify_pi(pi):
+    """Classify an index permutation ``new[i] = old[pi[i]]`` into its
+    cheapest lowering family: ``("xor", c)`` when pi is ``i ^ c``
+    (multi-qubit NOT — one static bit flip, no gather), ``("relabel", s)``
+    when pi only reroutes index BITS (output matrix bit j reads input
+    matrix bit s[j] — pure qubit relabeling, foldable into Qureg._perm),
+    else ``("gather", pi)`` (general one-hot row permutation, e.g. the
+    Toffoli's conditional flip)."""
+    pi = np.asarray(pi, dtype=np.int64)
+    d = len(pi)
+    k = d.bit_length() - 1
+    idx = np.arange(d)
+    c = int(pi[0])
+    if np.array_equal(pi, idx ^ c):
+        return ("xor", c)
+    if c == 0:
+        s = []
+        for j in range(k):
+            img = int(pi[1 << j])
+            if img and not (img & (img - 1)):
+                s.append(img.bit_length() - 1)
+        if len(s) == k and len(set(s)) == k:
+            lin = np.zeros(d, dtype=np.int64)
+            for j in range(k):
+                lin |= ((idx >> j) & 1) << s[j]
+            if np.array_equal(pi, lin):
+                return ("relabel", tuple(s))
+    return ("gather", tuple(int(p) for p in pi))
+
+
+@lru_cache(maxsize=512)
+def _classify_perm_cached(shape, dstr, buf):
+    m = np.frombuffer(buf, dtype=np.dtype(dstr)).reshape(shape)
+    if m[1].any():
+        return None
+    re = m[0]
+    if not np.all((re == 0) | (re == 1)):
+        return None
+    if not (np.all(re.sum(axis=0) == 1) and np.all(re.sum(axis=1) == 1)):
+        return None
+    return _classify_pi(re.argmax(axis=1))
+
+
+def classify_permutation_gate(mat):
+    """``None | ("xor", c) | ("relabel", s) | ("gather", pi)`` for a
+    concrete stacked SoA gate matrix (X, CNOT, Toffoli/MCX, SWAP,
+    multi-qubit NOT and products thereof).  Traced values and
+    non-permutation matrices return None.  Cached on the matrix bytes —
+    permutation-dominated streams repeat a handful of tiny matrices."""
+    if not isinstance(mat, np.ndarray) or mat.ndim != 3:
+        return None
+    if mat.shape[0] != 2 or mat.shape[1] != mat.shape[2]:
+        return None
+    return _classify_perm_cached(mat.shape, mat.dtype.str, mat.tobytes())
+
+
+def compose_permutation_run(gates):
+    """Fold a run of permutation-classified gates (stream order) into ONE
+    index permutation over the sorted union of their targets: returns
+    ``(union, pi)`` with ``new[i] = old[pi[i]]`` in union-bit order, or
+    None when any gate fails classification.  Exact integer arithmetic
+    throughout, so executing the composed table is bit-identical to the
+    dense matrix product."""
+    union = sorted({t for g in gates for t in g.targets})
+    upos = {q: j for j, q in enumerate(union)}
+    d = 1 << len(union)
+    idx = np.arange(d)
+    total = idx.copy()
+    for g in gates:
+        cls = classify_permutation_gate(g.mat)
+        if cls is None:
+            return None
+        kind, payload = cls
+        pos = [upos[t] for t in g.targets]
+        if kind == "xor":
+            mask = 0
+            for b, p in enumerate(pos):
+                if (payload >> b) & 1:
+                    mask |= 1 << p
+            lifted = idx ^ mask
+        else:
+            if kind == "relabel":
+                kg = len(pos)
+                gidx = np.arange(1 << kg)
+                pi_g = np.zeros(1 << kg, dtype=np.int64)
+                for j in range(kg):
+                    pi_g |= ((gidx >> j) & 1) << payload[j]
+            else:
+                pi_g = np.asarray(payload, dtype=np.int64)
+            sub = np.zeros(d, dtype=np.int64)
+            for b, p in enumerate(pos):
+                sub |= ((idx >> p) & 1) << b
+            mapped = pi_g[sub]
+            lifted = idx
+            for p in pos:
+                lifted = lifted & ~(1 << p)
+            for b, p in enumerate(pos):
+                lifted |= ((mapped >> b) & 1) << p
+        total = total[lifted]
+    return tuple(union), tuple(int(p) for p in total)
+
+
+def lower_permutation_run(gates, num_qubits: int):
+    """Lower a permutation-classified gate run to matrix-free plan ops:
+    greedy-group stream neighbors while the composed gather table stays
+    within PERM_GATHER_MAX_BITS, then emit per group the cheapest op its
+    composed permutation admits — ``("xor", flips)`` static flip,
+    ``("permute", perm)`` full-register bit relabel (one coalesced
+    transpose pass, kernels.permute_qubits), or
+    ``("gatherperm", union, pi)`` (kernels.apply_index_permutation)."""
+    ops: List[tuple] = []
+    group: List[Gate] = []
+    gbits: set = set()
+
+    def flush():
+        if not group:
+            return
+        union, pi = compose_permutation_run(group)
+        kind, payload = _classify_pi(pi)
+        if kind == "xor":
+            flips = tuple(union[j] for j in range(len(union))
+                          if (payload >> j) & 1)
+            if flips:
+                ops.append(("xor", flips))
+        elif kind == "relabel":
+            perm = list(range(num_qubits))
+            for j, q in enumerate(union):
+                perm[q] = union[payload[j]]
+            if perm != list(range(num_qubits)):
+                ops.append(("permute", tuple(perm)))
+        else:
+            ops.append(("gatherperm", tuple(union), tuple(payload)))
+        group.clear()
+        gbits.clear()
+
+    for g in gates:
+        b = set(g.targets)
+        if group:
+            nb = gbits | b
+            # cap the composed table AND the kernel's contiguous gather
+            # field — grouping distant gates would force the gather
+            # lowering onto its dense-matrix fallback
+            if (len(nb) > PERM_GATHER_MAX_BITS
+                    or max(nb) - min(nb) >= kernels._GATHER_FIELD_MAX_BITS):
+                flush()
+        group.append(g)
+        gbits |= b
+    flush()
+    return ops
+
+
+def perm_item_entry(targets, mat):
+    """Window-planner entry for one gate: ``("relabel", pairs)`` when the
+    gate is a pure bit relabel under QT_PERM_FAST — pairs =
+    ``((q, rho(q)), ...)`` meaning qubit q's new content comes from qubit
+    rho(q), the fold plan_remap_windows applies to the live permutation
+    with ZERO data motion — else the plain sorted bit tuple the dense
+    window planner localizes."""
+    if perm_fast_enabled():
+        cls = classify_permutation_gate(mat)
+        if cls is not None and cls[0] == "relabel":
+            s = cls[1]
+            pairs = tuple(sorted(
+                (targets[j], targets[s[j]])
+                for j in range(len(targets)) if s[j] != j))
+            return ("relabel", pairs) if pairs else ()
+    return tuple(sorted(targets))
+
+
+def _is_relabel_entry(entry) -> bool:
+    """True for the tagged ``("relabel", pairs)`` window-planner entry
+    (robust to the list-of-list mangling introspect._predict_cached
+    applies to its memo key)."""
+    return len(entry) == 2 and isinstance(entry[0], str) \
+        and entry[0] == "relabel"
+
+
+# ---------------------------------------------------------------------------
 # Cluster embedding: k-qubit matrix -> 128x128 via static index arrays
 # ---------------------------------------------------------------------------
 
@@ -1360,9 +1557,24 @@ def plan_remap_windows(bit_sets: Sequence[Tuple[int, ...]], num_qubits: int,
     i = 0
     total = len(bit_sets)
     while i < total:
+        if _is_relabel_entry(bit_sets[i]):
+            # permutation fold: a run of relabel-tagged items composes
+            # straight into the live logical->physical permutation — no
+            # sigma, no data motion; the composed exchange (if any) is
+            # deferred to the next canonical read like every other perm
+            j = i
+            while j < total and _is_relabel_entry(bit_sets[j]):
+                rho = dict(bit_sets[j][1])
+                perm = tuple(perm[rho.get(q, q)] for q in range(n))
+                j += 1
+            segments.append(((i, j), None, perm))
+            i = j
+            continue
         w: set = set()
         j = i
         while j < total:
+            if _is_relabel_entry(bit_sets[j]):
+                break
             b = set(bit_sets[j])
             if len(w | b) > nloc:
                 break
@@ -1378,6 +1590,8 @@ def plan_remap_windows(bit_sets: Sequence[Tuple[int, ...]], num_qubits: int,
         next_use: dict = {}
         d = 0
         for k in range(j, min(total, j + _REMAP_LOOKAHEAD)):
+            if _is_relabel_entry(bit_sets[k]):
+                continue
             for q in bit_sets[k]:
                 if q not in next_use:
                     next_use[q] = d
@@ -1431,6 +1645,12 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
             )
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
+        elif op[0] == "xor":
+            amps = kernels.apply_multi_qubit_not(
+                amps, num_qubits=n, targets=tuple(op[1]))
+        elif op[0] == "gatherperm":
+            amps = kernels.apply_index_permutation(
+                amps, num_qubits=n, targets=tuple(op[1]), pi=tuple(op[2]))
         elif op[0] == "sigma_swap":
             from .ops import bigstate
             amps = bigstate.apply_sigma_swap(
@@ -1539,6 +1759,8 @@ def stats(ops: Sequence[tuple]) -> dict:
             "winfused": c.get("winfused", 0),
             "apply": c.get("apply", 0), "segswap": c.get("segswap", 0),
             "permute": c.get("permute", 0),
+            "xor": c.get("xor", 0),
+            "gatherperm": c.get("gatherperm", 0),
             "sigma_swap": c.get("sigma_swap", 0),
             "total_passes": sum(c.values())}
 

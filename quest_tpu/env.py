@@ -373,6 +373,20 @@ def get_environment_string(env: QuESTEnv) -> str:
     from . import optimizer
 
     s += f" {optimizer.summary_line()}"
+    # §28 permutation fast paths (QT_PERM_FAST): flagged when disabled,
+    # plus cumulative per-route history once any gate lowered this way
+    from . import circuit as _circuit
+
+    pf = _circuit.perm_fast_enabled()
+    pg = telemetry.counter_total("permutation_gates_total")
+    if not pf or pg:
+        s += f" PermFast={'on' if pf else 'off'}"
+        routes = ",".join(
+            f"{r}:{int(telemetry.counter_sum('permutation_gates_total', route=r))}"
+            for r in ("relabel", "gather", "exchange")
+            if telemetry.counter_sum("permutation_gates_total", route=r))
+        if routes:
+            s += f"({routes})"
     spills = telemetry.counter_total("spills_total")
     if spills:
         s += f" Spills={int(spills)}"

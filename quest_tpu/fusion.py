@@ -151,7 +151,11 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None, nsh: int = 0):
         topo_sig = _topo.signature(1 << nsh)
     else:
         topo_sig = None
-    return (nloc, sweep_ok, perm0, topo_sig, _opt.mode(), tuple(parts))
+    # QT_PERM_FAST is part of the key: flipping it reroutes permutation
+    # runs between the gather/relabel lowering and the dense matmul
+    # pipeline, so a flip must retrace rather than replay a stale plan
+    return (nloc, sweep_ok, perm0, topo_sig, _opt.mode(),
+            C.perm_fast_enabled(), tuple(parts))
 
 
 def _split_items(items, nloc: int, sweep_ok: bool):
@@ -172,10 +176,18 @@ def _split_items(items, nloc: int, sweep_ok: bool):
         if seg:
             if not _QUIET[0]:
                 _telemetry.observe("fusion_window_gates", len(seg))
-            ops = C.plan_circuit(list(seg), nloc)
-            skeleton, arrs = C.split_plan(ops)
-            program.append(("plan", skeleton, len(arrs)))
-            arrays.extend(arrs)
+            for kind, sub in _perm_runs(seg):
+                if kind == "perm":
+                    # permutation run: matrix-free static lowering (§28)
+                    # — its own window kind, no gate-matrix stacks
+                    ops = C.lower_permutation_run(sub, nloc)
+                    if ops:
+                        program.append(("perm", tuple(ops)))
+                else:
+                    ops = C.plan_circuit(list(sub), nloc)
+                    skeleton, arrs = C.split_plan(ops)
+                    program.append(("plan", skeleton, len(arrs)))
+                    arrays.extend(arrs)
             seg.clear()
 
     def flush_chans():
@@ -209,6 +221,56 @@ def _item_bits(it) -> tuple:
     return tuple(it.targets)
 
 
+# minimum adjacent permutation-classified gates worth splitting out of a
+# dense segment: a lone X between dense neighbours fuses better inside
+# their window pass than as its own HBM sweep
+_PERM_RUN_MIN = 2
+
+
+def _perm_runs(seg):
+    """Partition one gate segment into maximal runs of permutation-
+    classified gates and interleaved dense runs, in stream order:
+    ``[("perm" | "dense", [gates...]), ...]``.  Runs shorter than
+    _PERM_RUN_MIN are demoted to dense; with QT_PERM_FAST off everything
+    is one dense run (the A/B baseline)."""
+    if not C.perm_fast_enabled():
+        return [("dense", list(seg))]
+    flags = [C.classify_permutation_gate(g.mat) is not None for g in seg]
+    i = 0
+    while i < len(seg):
+        if flags[i]:
+            j = i
+            while j < len(seg) and flags[j]:
+                j += 1
+            if j - i < _PERM_RUN_MIN:
+                for k in range(i, j):
+                    flags[k] = False
+            i = j
+        else:
+            i += 1
+    runs: List[tuple] = []
+    for flag, g in zip(flags, seg):
+        kind = "perm" if flag else "dense"
+        if runs and runs[-1][0] == kind:
+            runs[-1][1].append(g)
+        else:
+            runs.append((kind, [g]))
+    return runs
+
+
+def _item_entry(it):
+    """Window-planner entry for one drain item: channels expose their
+    (ket, bra) bits; gates go through circuit.perm_item_entry, which tags
+    pure bit-relabel gates for the zero-motion permutation fold.  EVERY
+    cost-model consumer — the sharded planner here, optimizer._stream_cost,
+    introspect.explain_circuit, and the §21 reconciliation — builds its
+    entries through this one function, so predictions and the dispatched
+    plan price the same stream and model drift stays 0 by construction."""
+    if isinstance(it, ChannelItem):
+        return (it.target, it.bra)
+    return C.perm_item_entry(it.targets, it.mat)
+
+
 def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
     """Windows + ONE batched remap each for a SHARDED drain: group
     consecutive items whose cumulative qubit set fits the shard-local
@@ -218,13 +280,19 @@ def _split_items_sharded(items, n: int, nloc: int, perm0, sweep_ok: bool):
     The permutation persists across windows AND drains — no swap-back;
     canonical order rematerializes on the next state read (Qureg.amps).
     Returns (program, arrays, final_perm)."""
-    segments, final_perm = C.plan_remap_windows(
-        [_item_bits(it) for it in items], n, nloc, perm0)
+    entries = [_item_entry(it) for it in items]
+    segments, final_perm = C.plan_remap_windows(entries, n, nloc, perm0)
     program: List[tuple] = []
     arrays: List[object] = []
     for (i, j), sigma, perm in segments:
         if not _QUIET[0]:
             _telemetry.observe("fusion_remap_window_items", j - i)
+        if C._is_relabel_entry(entries[i]):
+            # permutation fold (§28): items [i, j) composed straight into
+            # the plan's final permutation — zero data motion, nothing to
+            # dispatch; the composed cross-shard hop (if any) is deferred
+            # to the next canonical read like every other live perm
+            continue
         if sigma is not None:
             program.append(("remap", sigma))
         sub = []
@@ -348,6 +416,31 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
     if _telemetry.enabled():
         _telemetry.inc("fusion_windows_total",
                        sum(1 for p in program if p[0] == "plan"))
+        # permutation-family route accounting (§28): lowered window ops
+        # count by kind (one coalesced transpose = relabel, static
+        # xor/gather passes = gather); sharded relabel FOLDS — which
+        # dispatch nothing — count per item below
+        for part in program:
+            if part[0] != "perm":
+                continue
+            for op in part[1]:
+                _telemetry.inc(
+                    "permutation_gates_total",
+                    route="relabel" if op[0] == "permute" else "gather")
+        if nsh:
+            p0 = tuple(perm0) if perm0 is not None else tuple(range(n))
+            for it in items:
+                e = _item_entry(it)
+                if C._is_relabel_entry(e):
+                    # "exchange" when the fold touches bits resident on
+                    # the shard axis at drain start: the composed
+                    # cross-shard ppermute is deferred to the canonical
+                    # read rather than avoided
+                    ex = any(p0[a] >= nloc or p0[b] >= nloc
+                             for a, b in e[1])
+                    _telemetry.inc(
+                        "permutation_gates_total",
+                        route="exchange" if ex else "relabel")
         if nsh:
             bw = max(bsz, 1)  # each batch element exchanges its own amps
             # window-remap ICI accounting at dispatch time: each
@@ -387,7 +480,7 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
             from . import introspect as _introspect
 
             _introspect.reconcile_drain(
-                bit_sets=[_item_bits(it) for it in items],
+                bit_sets=[_item_entry(it) for it in items],
                 n=n, nloc=nloc, nsh=nsh, perm0=perm0, itemsize=itemsize,
                 batch=bsz,
                 measured_count=_telemetry.counter_sum(
@@ -488,7 +581,7 @@ def _part_advance(part, ai: int, pi: int):
         return ai + part[2], pi
     if part[0] == "chansweep":
         return ai, pi + len(part[1])
-    if part[0] == "remap":
+    if part[0] in ("remap", "perm"):
         return ai, pi
     return ai, pi + 1
 
@@ -575,6 +668,11 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
             amps = C.execute_plan(
                 amps, C.rebuild_plan(skeleton, arrays[ai:ai + na]),
                 nloc, precision=precision)
+        elif part[0] == "perm":
+            # matrix-free permutation window (§28): xor / gatherperm /
+            # permute ops are fully static — zero pass arrays
+            amps = C.execute_plan(amps, list(part[1]), nloc,
+                                  precision=precision)
         elif part[0] == "remap":
             # ONE batched window relocalization (mixed half-shard
             # swaps + per-shard axis permutation + composed shard

@@ -337,6 +337,63 @@ class SpillHandle:
         self.nbytes = int(amps.nbytes)
 
 
+class _SparseHandle:
+    """Lazy sparse-state handle (§28): ``initSparseState`` admits at the
+    cost of its indices + amplitude values and defers the dense
+    ``(2, 2^n)`` materialization to the first touch, where
+    :func:`restore_register` runs it under the ordinary admission
+    machinery (``spill_until`` makes room first).  Duck-types
+    :class:`SpillHandle` — restore reads ``.amps`` / ``.perm`` /
+    ``.dtype`` / ``.key_state`` and never learns the state was sparse."""
+
+    __slots__ = ("indices", "res", "ims", "num_amps", "perm", "dtype",
+                 "key_state", "nbytes")
+
+    def __init__(self, num_amps: int, indices, res, ims, dtype):
+        self.num_amps = int(num_amps)
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.res = np.asarray(res, dtype=np.dtype(dtype))
+        self.ims = np.asarray(ims, dtype=np.dtype(dtype))
+        self.perm = None
+        self.dtype = np.dtype(dtype)
+        self.key_state = None
+        self.nbytes = int(self.indices.nbytes + self.res.nbytes
+                          + self.ims.nbytes)
+
+    @property
+    def amps(self) -> np.ndarray:
+        out = np.zeros((2, self.num_amps), dtype=self.dtype)
+        out[0, self.indices] = self.res
+        out[1, self.indices] = self.ims
+        return out
+
+
+def admit_sparse_state(qureg, indices, res, ims,
+                       func: str = "initSparseState") -> None:
+    """Install a lazy sparse state: the register's device buffer is
+    dropped, the handle is admitted at SPARSE cost (indices + amplitude
+    values, NOT the dense 2^n footprint), and densification happens on
+    the first touch through restore_register — under admission control,
+    so a budget that cannot hold the dense state TODAY still accepts the
+    sparse description and spills neighbours when the drain arrives."""
+    h = _SparseHandle(1 << qureg.num_qubits_in_state_vec,
+                      indices, res, ims, qureg.dtype)
+    if enabled():
+        b = budget_bytes()
+        avail = b - resident_bytes(exclude=qureg)
+        if h.nbytes > avail:
+            _telemetry.inc("admission_rejects_total", func=func)
+            raise MemoryAdmissionError(func, h.nbytes, avail, b)
+    qureg._amps = None
+    qureg._perm = None
+    qureg._spill = h
+    e = _LEDGER.get(id(qureg))
+    if e is None:
+        track(qureg)
+        e = _LEDGER[id(qureg)]
+    e.spilled = True
+
+
 def spill_register(qureg) -> int:
     """Evict ``qureg``'s amplitudes to host memory behind a lazy
     :class:`SpillHandle`; returns the modeled per-device bytes freed

@@ -92,26 +92,40 @@ def _as_items(gates) -> list:
 
 
 def _segment_stats(items) -> tuple:
-    """(plan_windows, gates, channels) for one item run under
-    fusion._split_items's segmentation: each maximal consecutive gate
-    run folds into ONE ("plan", ...) part; channels emit chan/chansweep
-    parts, which fusion_windows_total does not count."""
+    """(plan_windows, gates, channels, perm_windows) for one item run
+    under fusion._split_items's segmentation: each maximal consecutive
+    gate run splits into permutation runs (§28 — their own ("perm", ...)
+    parts, which fusion_windows_total does NOT count) and dense runs
+    that fold into ONE ("plan", ...) part each; channels emit
+    chan/chansweep parts, also uncounted."""
     from . import fusion as F
 
     plan_parts = 0
+    perm_parts = 0
     gates = 0
     chans = 0
-    in_gates = False
+    seg: list = []
+
+    def flush():
+        nonlocal plan_parts, perm_parts
+        if not seg:
+            return
+        for kind, _sub in F._perm_runs(seg):
+            if kind == "perm":
+                perm_parts += 1
+            else:
+                plan_parts += 1
+        seg.clear()
+
     for it in items:
         if isinstance(it, F.ChannelItem):
             chans += 1
-            in_gates = False
+            flush()
         else:
             gates += 1
-            if not in_gates:
-                plan_parts += 1
-            in_gates = True
-    return plan_parts, gates, chans
+            seg.append(it)
+    flush()
+    return plan_parts, gates, chans, perm_parts
 
 
 def _sigma_cost(sigma, n: int, nloc: int, nsh: int, itemsize: int,
@@ -172,7 +186,7 @@ def _optimizer_section(orig_items, opt_items, ostats, *, n, nloc, nsh,
             if not seq:
                 return tiers, count
             segments, fperm = C.plan_remap_windows(
-                [F._item_bits(it) for it in seq], n, nloc, perm0)
+                [F._item_entry(it) for it in seq], n, nloc, perm0)
             sigmas = [s for _ij, s, _p in segments if s is not None]
             if fperm is not None and list(fperm) != list(range(n)):
                 sigmas.append(PAR.canonical_sigma(tuple(fperm)))
@@ -278,15 +292,30 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     tot_bytes = 0
     tot_tier = {"ici": 0, "dcn": 0}
     plan_windows = 0
+    perm_windows = 0
     if nsh and items:
-        segments, final_perm = C.plan_remap_windows(
-            [F._item_bits(it) for it in items], n, nloc, perm0)
+        entries = [F._item_entry(it) for it in items]
+        segments, final_perm = C.plan_remap_windows(entries, n, nloc, perm0)
         for k, ((i, j), sigma, _perm) in enumerate(segments):
-            parts, ngates, nchans = _segment_stats(items[i:j])
+            if C._is_relabel_entry(entries[i]):
+                # §28 permutation fold: nothing dispatches — the run is
+                # composed into the live perm; any cross-shard component
+                # surfaces in final_remap like every deferred hop
+                windows.append({"window": k, "start": int(i), "end": int(j),
+                                "gates": j - i, "channels": 0,
+                                "plan_windows": 0, "perm_windows": 0,
+                                "kind": "relabel", "sigma": None,
+                                "exchanges": 0, "exchange_bytes": 0,
+                                "chunks": None})
+                continue
+            parts, ngates, nchans, pparts = _segment_stats(items[i:j])
             plan_windows += parts
+            perm_windows += pparts
             entry = {"window": k, "start": int(i), "end": int(j),
                      "gates": ngates, "channels": nchans,
-                     "plan_windows": parts, "sigma": None,
+                     "plan_windows": parts, "perm_windows": pparts,
+                     "kind": "perm" if parts == 0 and pparts else "dense",
+                     "sigma": None,
                      "exchanges": 0, "exchange_bytes": 0, "chunks": None}
             if sigma is not None:
                 entry.update(_sigma_cost(sigma, n, nloc, nsh, itemsize))
@@ -312,12 +341,16 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                 final_remap["tier_exchanges"][t] *= bw
             final_remap["final_perm"] = [int(p) for p in final_perm]
     else:
-        parts, ngates, nchans = _segment_stats(items)
+        parts, ngates, nchans, pparts = _segment_stats(items)
         plan_windows = parts
+        perm_windows = pparts
         if items:
             windows.append({"window": 0, "start": 0, "end": len(items),
                             "gates": ngates, "channels": nchans,
-                            "plan_windows": parts, "sigma": None,
+                            "plan_windows": parts, "perm_windows": pparts,
+                            "kind": "perm" if parts == 0 and pparts
+                            else "dense",
+                            "sigma": None,
                             "exchanges": 0, "exchange_bytes": 0,
                             "chunks": None})
 
@@ -358,6 +391,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
         totals={
             "windows": len(windows),
             "plan_windows": int(plan_windows),
+            "perm_windows": int(perm_windows),
             "exchanges": int(tot_exch),
             "exchange_bytes": int(tot_bytes),
             "exchanges_with_read": int(tot_exch + read_exch),
@@ -395,7 +429,8 @@ def format_explain(report: dict) -> str:
         oline = (f"optimizer: mode={opt['mode']} "
                  f"gates {opt['gates_in']}->{opt['gates_out']} "
                  f"(cancel={rm['cancel']} merge={rm['merge']} "
-                 f"diag={rm['diag_coalesce']}"
+                 f"diag={rm['diag_coalesce']} "
+                 f"perm={rm.get('perm_coalesce', 0)}"
                  + (" reordered" if opt["reordered"] else "") + ")")
         if opt["windows_before"] is not None:
             oline += f" windows {opt['windows_before']}->{opt['windows_after']}"
@@ -404,29 +439,32 @@ def format_explain(report: dict) -> str:
             oline += (f" saves exch={opt['exchange_savings']} "
                       f"bytes ici={ts['ici']} dcn={ts['dcn']}")
         lines.append(oline)
-    cols = f"{'window':>7} {'items':>6} {'gates':>6} {'chans':>6} " \
-           f"{'exch':>5} {'bytes/shard':>12} {'chunks':>7}  sigma"
+    cols = f"{'window':>7} {'kind':>8} {'items':>6} {'gates':>6} " \
+           f"{'chans':>6} {'exch':>5} {'bytes/shard':>12} {'chunks':>7}" \
+           f"  sigma"
     lines.append(cols)
 
-    def row(label, items, gates, chans, entry):
+    def row(label, kind, items, gates, chans, entry):
         ch = entry.get("chunks")
         ch_s = f"{ch['half_shard']}/{ch['full_shard']}" if ch else "-"
         sig = entry.get("sigma")
         sig_s = "(" + ",".join(str(p) for p in sig) + ")" if sig else "-"
         lines.append(
-            f"{label:>7} {items:>6} {gates:>6} {chans:>6} "
+            f"{label:>7} {kind:>8} {items:>6} {gates:>6} {chans:>6} "
             f"{entry['exchanges']:>5} {entry['exchange_bytes']:>12} "
             f"{ch_s:>7}  {sig_s}")
 
     for w in report["windows"]:
-        row(str(w["window"]), w["end"] - w["start"], w["gates"],
-            w["channels"], w)
+        row(str(w["window"]), w.get("kind", "dense"),
+            w["end"] - w["start"], w["gates"], w["channels"], w)
     if report["final_remap"]:
-        row("read", "-", "-", "-", report["final_remap"])
+        row("read", "-", "-", "-", "-", report["final_remap"])
     t = report["totals"]
     lines.append(
-        f"totals: plan_windows={t['plan_windows']} "
-        f"exchanges={t['exchanges']} bytes={t['exchange_bytes']}"
+        f"totals: plan_windows={t['plan_windows']}"
+        + (f" perm_windows={t['perm_windows']}"
+           if t.get("perm_windows") else "")
+        + f" exchanges={t['exchanges']} bytes={t['exchange_bytes']}"
         + (f" (+{t['exchanges_with_read'] - t['exchanges']} exch / "
            f"+{t['exchange_bytes_with_read'] - t['exchange_bytes']} bytes "
            f"at read)" if report["final_remap"] else ""))

@@ -753,6 +753,60 @@ def swap_bit_segments(amps, *, num_qubits: int, a: int, b: int, m: int):
     return jnp.transpose(view, (0, 1, 4, 3, 2, 5)).reshape(amps.shape)
 
 
+# Gather field width cap for apply_index_permutation: past this extent the
+# static index table (2^width entries) stops being worth materializing and
+# the op falls back to the exact 0/1 permutation-matrix pass.
+_GATHER_FIELD_MAX_BITS = 16
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "targets", "pi"), donate_argnums=0)
+def apply_index_permutation(
+    amps, *, num_qubits: int, targets: Tuple[int, ...], pi: Tuple[int, ...]
+):
+    """General basis-index permutation on ``targets``: the new amplitude at
+    target-field sub-index i is the old amplitude at sub-index ``pi[i]``
+    (``new[i] = old[pi[i]]``, matching circuit.classify_permutation_gate's
+    row convention).  This is the gather lowering of the permutation gate
+    family (circuit.py §28) — CNOT/Toffoli/MCX products execute as ONE
+    static gather pass instead of a cluster matmul, and the move is
+    bit-exact (amplitudes are relocated, never recombined).
+
+    Layout: the gather runs along a contiguous bit field [lo, hi] covering
+    the targets, viewed as (2, pre, 2^field, 2^lo).  At n >= _BIG_N a field
+    reaching below the 128-lane block is extended down to bit 0 so the
+    gathered axis stays tile-wide (the tiny-minor rule every kernel here
+    follows); fields wider than _GATHER_FIELD_MAX_BITS fall back to the
+    exact 0/1 permutation matrix through _apply_matrix_flat (single gates
+    have <= 7 targets, so the matrix stays <= 128x128)."""
+    n = num_qubits
+    lo, hi = min(targets), max(targets)
+    if n >= _BIG_N and lo < _LANE_BITS:
+        lo = 0
+        hi = max(hi, _LANE_BITS - 1)
+    if hi + 1 - lo > _GATHER_FIELD_MAX_BITS:
+        d = 1 << len(targets)
+        m = np.zeros((2, d, d), np.float64)
+        m[0, np.arange(d), np.asarray(pi, dtype=np.int64)] = 1.0
+        return _apply_matrix_flat(
+            amps, n, tuple(targets), jnp.asarray(m, amps.dtype)
+        ).reshape(amps.shape)
+    span = hi + 1 - lo
+    d = 1 << span
+    idx = np.arange(d)
+    sub = np.zeros(d, dtype=np.int64)
+    for b, t in enumerate(targets):
+        sub |= ((idx >> (t - lo)) & 1) << b
+    mapped = np.asarray(pi, dtype=np.int64)[sub]
+    lifted = idx.copy()
+    for t in targets:
+        lifted &= ~(1 << (t - lo))
+    for b, t in enumerate(targets):
+        lifted |= ((mapped >> b) & 1) << (t - lo)
+    view = amps.reshape(2, 1 << (n - hi - 1), d, 1 << lo)
+    out = view[:, :, jnp.asarray(lifted), :]
+    return out.reshape(amps.shape)
+
+
 # ---------------------------------------------------------------------------
 # State initialisation (reference QuEST_cpu.c:1453-1729)
 # ---------------------------------------------------------------------------
@@ -775,6 +829,18 @@ def init_plus_state(num_amps: int, dtype):
 
 def init_classical_state(num_amps: int, state_index: int, dtype):
     return jnp.zeros((2, num_amps), dtype=dtype).at[0, state_index].set(1.0)
+
+
+def init_sparse_state(num_amps: int, indices, res, ims, dtype):
+    """Scatter k nonzero amplitudes into an otherwise-zero state — the
+    dense-side materialization of sparse state preparation (circuit.py
+    §28, arXiv:2504.08705): cost scales with k for the scatter plus one
+    zeros fill, never with explicit per-amplitude host uploads."""
+    idx = jnp.asarray(np.asarray(indices, dtype=np.int64))
+    re = jnp.asarray(res, dtype=dtype)
+    im = jnp.asarray(ims, dtype=dtype)
+    return (jnp.zeros((2, num_amps), dtype=dtype)
+            .at[0, idx].set(re).at[1, idx].set(im))
 
 
 def init_debug_state(num_amps: int, dtype):

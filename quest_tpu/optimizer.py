@@ -22,6 +22,14 @@ semantics-preserving transforms over the buffered item stream:
   the union targets (capped at the fusion gate width), replacing a chain
   of small matmul passes with a single phase-mask application.
 
+* **Permutation coalescing** (§28) — maximal runs of adjacent
+  permutation-classified gates (X / CNOT / Toffoli / SWAP chains,
+  ``circuit.classify_permutation_gate``) compose by exact integer index
+  arithmetic into ONE permutation gate on the union targets; identity
+  products drop.  The composed gates still classify as permutations, so
+  the fusion layer's gather/relabel lowering fires on the coalesced
+  stream.  Gated on ``QT_PERM_FAST`` like the lowering itself.
+
 * **Commutation-aware reordering** (sharded registers) — a dependency
   DAG over the stream (edges between non-commuting items; commutation =
   disjoint supports, diagonal↔diagonal, or same-target matrices that
@@ -191,6 +199,11 @@ def _is_diag(it) -> bool:
     return _concrete(it) and it.mat.ndim == 3 and C.is_diag_gate(it.mat)
 
 
+def _is_perm(it) -> bool:
+    return _concrete(it) and it.mat.ndim == 3 \
+        and C.classify_permutation_gate(it.mat) is not None
+
+
 def _mats_commute(a: np.ndarray, b: np.ndarray) -> bool:
     ab = _soa_matmul_any(a, b)
     ba = _soa_matmul_any(b, a)
@@ -334,6 +347,69 @@ def _coalesce_diag(items: list, removed: dict, nloc: int) -> list:
 
 
 # ---------------------------------------------------------------------------
+# Pass 2b: permutation coalescing (§28)
+# ---------------------------------------------------------------------------
+
+
+def _compose_perm_run(run: Sequence[C.Gate]):
+    """ONE permutation gate equal to a run of permutation-classified
+    gates: the composed index table comes from exact integer arithmetic
+    (circuit.compose_permutation_run), so the 0/1 matrix is bit-identical
+    to applying the run gate-by-gate.  Returns None when the run
+    composes to the identity (e.g. SWAP·SWAP across distinct pairs)."""
+    union, pi = C.compose_permutation_run(run)
+    d = 1 << len(union)
+    idx = np.arange(d)
+    if np.array_equal(np.asarray(pi), idx):
+        return None
+    dt = np.result_type(*[g.mat.dtype for g in run])
+    mat = np.zeros((2, d, d), dtype=dt)
+    mat[0, idx, np.asarray(pi)] = 1.0
+    return C.Gate(tuple(union), mat)
+
+
+def _coalesce_perm(items: list, removed: dict, nloc: int) -> list:
+    """Collapse maximal runs of ADJACENT permutation-classified gates
+    (X / CNOT / Toffoli / SWAP chains) whose union target set fits one
+    fused gate into a single permutation gate; runs composing to the
+    identity drop outright.  Long chains shrink to short runs of wide
+    gates that still classify as permutations, so the fusion layer's
+    §28 gather lowering fires on the coalesced stream too."""
+    if not C.perm_fast_enabled():
+        return items
+    cap = min(MAX_GATE_QUBITS, nloc)
+    out: list = []
+    run: list = []
+    runbits: set = set()
+
+    def flush():
+        if len(run) >= 2:
+            g = _compose_perm_run(run)
+            if g is None:
+                removed["perm_coalesce"] += len(run)
+            else:
+                out.append(g)
+                removed["perm_coalesce"] += len(run) - 1
+        else:
+            out.extend(run)
+        run.clear()
+        runbits.clear()
+
+    for it in items:
+        if _is_perm(it):
+            b = set(it.targets)
+            if len(runbits | b) > cap:
+                flush()
+            run.append(it)
+            runbits |= b
+        else:
+            flush()
+            out.append(it)
+    flush()
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Pass 3: commutation-aware reordering (sharded registers)
 # ---------------------------------------------------------------------------
 
@@ -345,13 +421,17 @@ def _stream_cost(items: Sequence, n: int, nloc: int, perm0) -> tuple:
     and reconcile_drain verifies, plus the canonical-read remap the next
     ``Qureg.amps`` pays, so clustering cannot win by deferring cost to
     the read."""
+    from . import fusion as F
     from .parallel import dist as PAR
     from .parallel import topology as _topo
 
     nsh = n - nloc
     weights = _topo.tier_weights()
+    # entries MUST come from fusion._item_entry — the same constructor
+    # the sharded planner and §21 reconciliation use — so relabel-tagged
+    # permutation gates fold here exactly as they will at dispatch
     segments, final_perm = C.plan_remap_windows(
-        [tuple(sorted(_bits(it))) for it in items], n, nloc, perm0)
+        [F._item_entry(it) for it in items], n, nloc, perm0)
     sigmas = [s for _ij, s, _p in segments if s is not None]
     if final_perm is not None and list(final_perm) != list(range(n)):
         sigmas.append(PAR.canonical_sigma(tuple(final_perm)))
@@ -471,7 +551,10 @@ def _content_key(items, n: int, nloc: int, nsh: int, perm0, m: str):
         topo_sig = _topo.signature(1 << nsh)
     else:
         topo_sig = None
-    return (m, n, nloc, nsh, perm0, topo_sig, tuple(parts))
+    # QT_PERM_FAST flips change both the coalesce pass and the tagged
+    # stream-cost entries — flips must miss, like the fusion plan key
+    return (m, n, nloc, nsh, perm0, topo_sig, C.perm_fast_enabled(),
+            tuple(parts))
 
 
 def _rewrite(items: list, nloc: int, aggressive: bool,
@@ -479,13 +562,15 @@ def _rewrite(items: list, nloc: int, aggressive: bool,
     """cancel/merge (+ optional diagonal coalescing) to a small fixpoint
     — the two passes feed each other (a coalesced diagonal may cancel
     against its inverse).  Returns (items, removed)."""
-    removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0}
+    removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0,
+               "perm_coalesce": 0}
     out = list(items)
     for _ in range(3):
         before = len(out)
         out = _cancel_merge(out, removed, aggressive)
         if coalesce:
             out = _coalesce_diag(out, removed, nloc)
+            out = _coalesce_perm(out, removed, nloc)
         if len(out) == before:
             break
     return out, removed
@@ -529,7 +614,8 @@ def _optimize(items: list, n: int, nloc: int, nsh: int, perm0,
                 _k, out, removed, reordered, cost_after = best
             else:  # nothing beat program order: keep the stream as-is
                 out = list(items)
-                removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0}
+                removed = {"cancel": 0, "merge": 0, "diag_coalesce": 0,
+                           "perm_coalesce": 0}
                 reordered = False
                 cost_after = cost_before
             windows_before = int(cost_before[2])
@@ -579,7 +665,7 @@ def optimize_items(items: Sequence, *, n: int, nloc: int, nsh: int = 0,
         ngates = sum(1 for it in items if _is_gate(it))
         return items, {"mode": m, "gates_in": ngates, "gates_out": ngates,
                        "removed": {"cancel": 0, "merge": 0,
-                                   "diag_coalesce": 0},
+                                   "diag_coalesce": 0, "perm_coalesce": 0},
                        "reordered": False, "windows_before": None,
                        "windows_after": None,
                        "weighted_cost_before": None,

@@ -548,7 +548,7 @@ def perf_report(env=None) -> str:
 
         by_kind = " ".join(
             f"{k}={_num(counter_sum('optimizer_gates_removed_total', kind=k))}"
-            for k in ("cancel", "merge", "diag_coalesce")
+            for k in ("cancel", "merge", "diag_coalesce", "perm_coalesce")
             if counter_sum("optimizer_gates_removed_total", kind=k))
         lines.append(f"circuit optimizer (mode={_optimizer.mode()}):")
         lines.append(f"  gates removed: total={_num(removed)} {by_kind}")
@@ -560,6 +560,20 @@ def perf_report(env=None) -> str:
             lines.append(
                 f"  optimize time: count={tot_n} "
                 f"mean={tot_s / tot_n:.6g}s")
+    perm = counter_total("permutation_gates_total")
+    sparse = counter_total("sparse_inits_total")
+    if perm or sparse:
+        lines.append("permutation fast paths (§28):")
+        if perm:
+            by_route = " ".join(
+                f"{r}={_num(counter_sum('permutation_gates_total', route=r))}"
+                for r in ("relabel", "gather", "exchange")
+                if counter_sum("permutation_gates_total", route=r))
+            lines.append(f"  gates: total={_num(perm)} {by_route}")
+        if sparse:
+            lines.append(
+                f"  sparse inits: {_num(sparse)} "
+                f"(amps={_num(counter_total('sparse_init_amps_total'))})")
     pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
     meas_c = counter_sum("exchanges_total", op="window_remap")
     pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")

@@ -1,0 +1,264 @@
+"""Permutation fast-path + sparse-init A/B (ISSUE 15 acceptance):
+QT_PERM_FAST=on vs off on the workloads the §28 lowering targets, with
+amplitude parity checked between arms.
+
+Three workloads, all on the 8-shard dryrun mesh:
+
+* ``relabel`` — a SWAP-only chain on shard-LOCAL bits: the on arm folds
+  the entire stream into the lazy qubit permutation (zero dispatched
+  window ops) and the deferred canonical-read remap must compile to
+  ZERO collectives (pinned via introspect.audit under
+  CollectiveBudget(exact={})); the off arm pays a dense 4x4 window
+  matmul per SWAP;
+* ``ripple``  — a ripple-carry-adder-style CNOT/Toffoli chain (the
+  bench_suite config-16 shape): gather/XOR lowering vs dense window
+  matmuls;
+* ``sparse``  — sparse clustered state preparation
+  (initSparseClusteredState, arXiv:2504.08705): time-to-admitted
+  register (the sparse description admits at O(k) cost, densifying
+  lazily) vs the dense host-array initStateFromAmps round-trip.
+
+Per arm the script records best-of-``reps`` wall clock and
+``model_drift_total`` (must stay 0 — §21 prices the lowered stream
+too).  Headline metrics: ``perm_speedup_x`` (off/on seconds across
+relabel+ripple, gated >= 5x) and ``sparse_init_speedup_x``.
+
+Usage: python scripts/bench_sparse.py [--n 18] [--depth 60] [--reps 2]
+       [--no-check]
+Needs the 8-device virtual mesh (make verify-sparse).  --no-check
+skips the gating asserts (speedup, parity, drift, zero-collective).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import telemetry as T  # noqa: E402
+from quest_tpu.parallel import dist as PAR  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    qt.set_precision(2)  # f64 parity tolerance for the CPU dryrun
+
+PARITY_TOL = 1e-10 if qt.get_precision() == 2 else 1e-4
+SPEEDUP_FLOOR = 5.0
+
+
+def _arg(flag, default, cast=int):
+    return cast(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def _relabel_ops(q, n, depth):
+    """SWAP-only churn on shard-local bits (relabel-only stream)."""
+    nloc = n - 3
+    rng = np.random.default_rng(13)
+    for _ in range(depth):
+        a, b = (int(v) for v in rng.choice(nloc, size=2, replace=False))
+        qt.swapGate(q, a, b)
+
+
+def _ripple_ops(q, n, depth):
+    """Ripple-carry-adder-style CNOT/Toffoli chain (config-16 shape)."""
+    for r in range(max(1, depth // (2 * (n - 2)))):
+        qt.pauliX(q, r % n)
+        for i in range(n - 2):
+            qt.controlledNot(q, i, i + 1)
+            qt.multiControlledMultiQubitNot(q, [i, i + 1], [i + 2])
+        for i in range(n - 1):
+            qt.controlledNot(q, i, i + 1)
+
+
+def _run_gate_arm(env, build, flag, n, depth, reps):
+    """One QT_PERM_FAST arm of one gate workload: best-of-reps drain."""
+    os.environ["QT_PERM_FAST"] = flag
+    best = float("inf")
+    amps = None
+    drift = exchanges = 0
+    perm_for_audit = None
+    for rep in range(reps + 1):  # rep 0 = warm-up/compile
+        T.reset()
+        q = qt.createQureg(n, env)
+        qt.initDebugState(q)
+        qt.startGateFusion(q)
+        build(q, n, depth)
+        t0 = time.perf_counter()
+        qt.stopGateFusion(q)
+        _ = q._amps_raw()  # drain (no canonical remap yet)
+        exchanges = int(T.counter_sum("exchanges_total", op="window_remap"))
+        perm_for_audit = q._perm
+        amps = np.asarray(q.amps)  # canonical read joins the timed cost
+        seconds = time.perf_counter() - t0
+        if rep:
+            best = min(best, seconds)
+        drift = int(T.counter_total("model_drift_total"))
+    return {"perm_fast": flag, "seconds": round(best, 4),
+            "window_remap_exchanges": exchanges,
+            "drift": drift}, amps, perm_for_audit
+
+
+def _audit_relabel_read(env, n, perm):
+    """Compile the deferred canonical-read remap of a relabel-only
+    stream and histogram its collectives (must be empty: the fold left
+    only shard-local movement)."""
+    if perm is None:
+        return {}
+    q = qt.createQureg(n, env)
+    qt.initDebugState(q)
+
+    def canonical_read(a):
+        return PAR.remap_sharded(a, mesh=env.mesh, num_qubits=n,
+                                 sigma=PAR.canonical_sigma(perm))
+
+    with qt.CollectiveBudget(exact={}):
+        rep = qt.audit(canonical_read, q._amps_raw())
+    return dict(rep.collectives)
+
+
+def _run_sparse_arm(env, n, sparse, reps):
+    """Time-to-initialized-register for a sparse CLUSTERED state
+    (arXiv:2504.08705): the sparse description admits at O(k) cost
+    (densify deferred to first touch); the dense arm builds and ships
+    the full 2^n host arrays.  Parity checked untimed."""
+    nblocks = 1 << max(0, n - 12)
+    blen = 4
+    rng = np.random.default_rng(29)
+    bases = np.sort(rng.choice((1 << n) // blen, size=nblocks,
+                               replace=False)) * blen
+    blocks = rng.standard_normal((nblocks, blen)) \
+        / np.sqrt(nblocks * blen)
+    best = float("inf")
+    amps = None
+    for rep in range(reps + 1):
+        q = qt.createQureg(n, env)
+        if sparse:
+            t0 = time.perf_counter()
+            qt.initSparseClusteredState(q, bases, blocks)
+            seconds = time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            re = np.zeros(1 << n)
+            for base, block in zip(bases, blocks):
+                re[base:base + blen] = block
+            qt.initStateFromAmps(q, re, np.zeros(1 << n))
+            seconds = time.perf_counter() - t0
+        if rep:
+            best = min(best, seconds)
+        amps = np.asarray(q.amps)  # untimed: densify + parity read
+    return {"sparse": sparse, "seconds": round(best, 5),
+            "nonzeros": int(nblocks * blen)}, amps
+
+
+def run(n=18, depth=60, reps=2):
+    env = qt.createQuESTEnv()
+    if env.num_devices < 8:
+        raise RuntimeError(
+            "bench_sparse needs the 8-device virtual mesh — run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    prev_mode = T.mode_name()
+    prev_flag = os.environ.get("QT_PERM_FAST")
+    T.configure("on")
+    results = {}
+    try:
+        for name, build in (("relabel", _relabel_ops),
+                            ("ripple", _ripple_ops)):
+            off, a_off, _p = _run_gate_arm(env, build, "off", n, depth,
+                                           reps)
+            on, a_on, perm = _run_gate_arm(env, build, "on", n, depth,
+                                           reps)
+            results[name] = {
+                "off": off, "on": on,
+                "speedup_x": round(off["seconds"]
+                                   / max(on["seconds"], 1e-9), 2),
+                "max_abs_err": float(np.abs(a_on - a_off).max()),
+            }
+            if name == "relabel":
+                results[name]["read_collectives"] = \
+                    _audit_relabel_read(env, n, perm)
+        os.environ["QT_PERM_FAST"] = "on"
+        dense, a_dense = _run_sparse_arm(env, n, False, reps)
+        sparse, a_sparse = _run_sparse_arm(env, n, True, reps)
+        results["sparse"] = {
+            "dense": dense, "sparse": sparse,
+            "speedup_x": round(dense["seconds"]
+                               / max(sparse["seconds"], 1e-9), 2),
+            "max_abs_err": float(np.abs(a_sparse - a_dense).max()),
+        }
+    finally:
+        if prev_flag is None:
+            os.environ.pop("QT_PERM_FAST", None)
+        else:
+            os.environ["QT_PERM_FAST"] = prev_flag
+        T.reset()
+        T.configure(prev_mode)
+    perm_off = sum(results[w]["off"]["seconds"]
+                   for w in ("relabel", "ripple"))
+    perm_on = sum(results[w]["on"]["seconds"]
+                  for w in ("relabel", "ripple"))
+    return {
+        "bench": "sparse_permfast_ab",
+        "n": n, "depth": depth, "reps": reps,
+        "backend": jax.default_backend(),
+        "devices": env.num_devices,
+        "workloads": results,
+        "perm_speedup_x": round(perm_off / max(perm_on, 1e-9), 2),
+        "sparse_init_speedup_x": results["sparse"]["speedup_x"],
+    }
+
+
+def main():
+    rec = run(n=_arg("--n", 18), depth=_arg("--depth", 60),
+              reps=_arg("--reps", 2))
+    print(json.dumps(rec), flush=True)
+    if "--no-check" in sys.argv:
+        return 0
+    ok = True
+    for name in ("relabel", "ripple", "sparse"):
+        r = rec["workloads"][name]
+        if r["max_abs_err"] > PARITY_TOL:
+            print(f"FAIL: {name} on/off amplitude mismatch "
+                  f"{r['max_abs_err']:.3e} — the lowering must be "
+                  f"semantics-preserving", file=sys.stderr)
+            ok = False
+    for name in ("relabel", "ripple"):
+        for arm in ("off", "on"):
+            if rec["workloads"][name][arm]["drift"]:
+                print(f"FAIL: {name}/{arm} model_drift_total="
+                      f"{rec['workloads'][name][arm]['drift']} (§21 must "
+                      f"price the lowered stream too)", file=sys.stderr)
+                ok = False
+    if rec["workloads"]["relabel"]["on"]["window_remap_exchanges"]:
+        print("FAIL: relabel-only stream dispatched window exchanges "
+              f"({rec['workloads']['relabel']['on']}"
+              ") — the fold must be zero-motion", file=sys.stderr)
+        ok = False
+    if sum(rec["workloads"]["relabel"]["read_collectives"].values()):
+        print("FAIL: relabel-only canonical read compiled collectives "
+              f"{rec['workloads']['relabel']['read_collectives']}",
+              file=sys.stderr)
+        ok = False
+    if rec["perm_speedup_x"] < SPEEDUP_FLOOR:
+        print(f"FAIL: perm_speedup_x {rec['perm_speedup_x']}x below the "
+              f"{SPEEDUP_FLOOR}x acceptance floor", file=sys.stderr)
+        ok = False
+    if rec["sparse_init_speedup_x"] < 1.0:
+        print("FAIL: sparse init slower than the dense host round-trip "
+              f"({rec['sparse_init_speedup_x']}x)", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,509 @@
+"""Permutation-gate fast paths + sparse state preparation (ISSUE 15,
+docs/design.md §28).
+
+Covers the acceptance contract:
+  * permutation-lowered streams are BIT-IDENTICAL to the dense matmul
+    path where the lowering is exact (pure relabel/gather) and within
+    1e-10 elsewhere, on scalar, 8-shard, batched-bank and density
+    registers, including seeded measurement through run_resumable;
+  * relabel-only streams fold into the lazy permutation with ZERO
+    window exchanges, and the deferred canonical-read remap compiles to
+    ZERO collectives when every relabeled bit is shard-local
+    (introspect.audit under CollectiveBudget(exact={}));
+  * initSparseState round-trips bit-identically vs setAmps, admits
+    under the governor at SPARSE cost and densifies lazily on first
+    touch, and survives checkpoint/resume bit-identically;
+  * scalar swapGate routes through ONE kernels.permute_qubits call
+    (kernel count pinned), telemetry routes land in
+    permutation_gates_total{route} / dispatch_total{family=permutation},
+    and explainCircuit reports the permutation window kind.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import circuit as CIRC
+from quest_tpu import fusion as F
+from quest_tpu import governor as G
+from quest_tpu import telemetry as T
+from quest_tpu.ops import kernels as K
+from quest_tpu.parallel import dist as PAR
+
+_SQ2 = 1.0 / np.sqrt(2.0)
+X_SOA = np.stack([np.array([[0.0, 1], [1, 0]]), np.zeros((2, 2))])
+H_SOA = np.stack([_SQ2 * np.array([[1.0, 1], [1, -1]]), np.zeros((2, 2))])
+CX_SOA = np.stack([
+    np.array([[1.0, 0, 0, 0], [0, 1, 0, 0], [0, 0, 0, 1], [0, 0, 1, 0]]),
+    np.zeros((4, 4)),
+])
+SWAP_SOA = np.stack([
+    np.array([[1.0, 0, 0, 0], [0, 0, 1, 0], [0, 1, 0, 0], [0, 0, 0, 1]]),
+    np.zeros((4, 4)),
+])
+
+
+@pytest.fixture(scope="module")
+def env1():
+    return qt.createQuESTEnv(num_devices=1)
+
+
+@pytest.fixture
+def env8(env):
+    if env.num_devices < 8:
+        pytest.skip("needs the 8-device dryrun mesh")
+    return env
+
+
+@pytest.fixture
+def tele():
+    mode = T.mode_name()
+    T.configure("on")
+    T.reset()
+    yield
+    T.reset()
+    T.configure(mode)
+
+
+@pytest.fixture
+def fresh_gov(monkeypatch):
+    monkeypatch.delenv("QT_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.delenv("QT_MEM_POLICY", raising=False)
+    G.reset()
+    yield monkeypatch
+    G.reset()
+
+
+# ---------------------------------------------------------------------------
+# randomized stream helpers
+
+_PERM_OPS = ("pauliX", "controlledNot", "swapGate", "mcmqn")
+_DENSE_OPS = ("hadamard", "tGate")
+
+
+def _random_stream(rng, nq, depth, *, perm_only=False):
+    """Random op list as (api_name, args) pairs: X / CNOT / SWAP /
+    multi-controlled-multi-NOT, optionally interleaved with dense H/T."""
+    names = _PERM_OPS if perm_only else _PERM_OPS + _DENSE_OPS
+    ops = []
+    for _ in range(depth):
+        name = names[int(rng.integers(len(names)))]
+        if name in ("hadamard", "tGate", "pauliX"):
+            ops.append((name, (int(rng.integers(nq)),)))
+        elif name == "controlledNot":
+            c, t = (int(v) for v in rng.choice(nq, size=2, replace=False))
+            ops.append((name, (c, t)))
+        elif name == "swapGate":
+            a, b = (int(v) for v in rng.choice(nq, size=2, replace=False))
+            ops.append((name, (a, b)))
+        else:  # Toffoli-shaped multiControlledMultiQubitNot
+            if nq < 3:
+                continue
+            c1, c2, t = (int(v) for v in
+                         rng.choice(nq, size=3, replace=False))
+            ops.append(("multiControlledMultiQubitNot", ([c1, c2], [t])))
+    return ops
+
+
+def _apply_stream(q, ops):
+    with qt.gateFusion(q):
+        for name, args in ops:
+            getattr(qt, name)(q, *args)
+    return np.asarray(q.amps)
+
+
+def _make_state(env, nq, kind="plus"):
+    q = qt.createQureg(nq, env)
+    if kind == "plus":
+        qt.initPlusState(q)
+    else:
+        qt.initDebugState(q)
+    return q
+
+
+def _ab_arms(monkeypatch, env, nq, ops, kind="plus"):
+    """Run the same stream with QT_PERM_FAST on then off; return both
+    amplitude arrays (off arm = the dense baseline)."""
+    monkeypatch.setenv("QT_PERM_FAST", "on")
+    a_on = _apply_stream(_make_state(env, nq, kind), ops)
+    monkeypatch.setenv("QT_PERM_FAST", "off")
+    a_off = _apply_stream(_make_state(env, nq, kind), ops)
+    return a_on, a_off
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_gate_families(self):
+        assert CIRC.classify_permutation_gate(X_SOA)[0] == "xor"
+        assert CIRC.classify_permutation_gate(SWAP_SOA)[0] == "relabel"
+        assert CIRC.classify_permutation_gate(CX_SOA)[0] == "gather"
+        assert CIRC.classify_permutation_gate(H_SOA) is None
+
+    def test_compose_run_is_exact(self):
+        gates = [CIRC.Gate((0,), X_SOA), CIRC.Gate((0, 1), CX_SOA),
+                 CIRC.Gate((1, 2), SWAP_SOA)]
+        union, pi = CIRC.compose_permutation_run(gates)
+        assert tuple(union) == (0, 1, 2)
+        d = 1 << len(union)
+        # replay the integer table against a dense basis sweep
+        mat = np.zeros((2, d, d))
+        mat[0, np.arange(d), np.asarray(pi)] = 1.0
+        acc = np.eye(d)
+        for g in gates:
+            gm = np.zeros((d, d))
+            # embed each gate into the 3-bit space by brute force
+            for i in range(d):
+                bits = [(i >> b) & 1 for b in range(3)]
+                sub = 0
+                for k, t in enumerate(g.targets):
+                    sub |= bits[t] << k
+                col = int(np.argmax(g.mat[0][:, sub]))
+                out = list(bits)
+                for k, t in enumerate(g.targets):
+                    out[t] = (col >> k) & 1
+                j = sum(b << k for k, b in enumerate(out))
+                gm[j, i] = 1.0
+            acc = gm @ acc
+        assert np.array_equal(mat[0], acc)
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_scalar_mixed_stream(self, env1, monkeypatch, seed):
+        ops = _random_stream(np.random.default_rng(seed), 7, 40)
+        a_on, a_off = _ab_arms(monkeypatch, env1, 7, ops)
+        np.testing.assert_allclose(a_on, a_off, atol=1e-10, rtol=0)
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_scalar_pure_perm_bit_identical(self, env1, monkeypatch, seed):
+        ops = _random_stream(np.random.default_rng(seed), 7, 30,
+                             perm_only=True)
+        a_on, a_off = _ab_arms(monkeypatch, env1, 7, ops, kind="debug")
+        assert np.array_equal(a_on, a_off)
+
+    @pytest.mark.parametrize("seed", [5, 6])
+    def test_sharded_parity_zero_drift(self, env8, monkeypatch, tele, seed):
+        ops = _random_stream(np.random.default_rng(seed), 10, 30)
+        a_on, a_off = _ab_arms(monkeypatch, env8, 10, ops)
+        np.testing.assert_allclose(a_on, a_off, atol=1e-10, rtol=0)
+        # §21 reconciliation: the perm-priced plan drifts 0 in BOTH arms
+        assert T.counter_total("model_drift_total") == 0
+
+    def test_sharded_pure_perm_bit_identical(self, env8, monkeypatch):
+        ops = _random_stream(np.random.default_rng(8), 9, 24,
+                             perm_only=True)
+        a_on, a_off = _ab_arms(monkeypatch, env8, 9, ops, kind="debug")
+        assert np.array_equal(a_on, a_off)
+
+    def test_batched_parity(self, env8, monkeypatch):
+        # 1-2 qubit gates only: a BatchedQureg bank has no eager scalar
+        # fallback for gates the capture path rejects
+        ops = [op for op in _random_stream(np.random.default_rng(9), 5, 20)
+               if op[0] != "multiControlledMultiQubitNot"]
+
+        def run():
+            bq = qt.createBatchedQureg(5, env8, 3)
+            return _apply_stream(bq, ops)
+
+        monkeypatch.setenv("QT_PERM_FAST", "on")
+        a_on = run()
+        monkeypatch.setenv("QT_PERM_FAST", "off")
+        a_off = run()
+        np.testing.assert_allclose(a_on, a_off, atol=1e-10, rtol=0)
+
+    def test_density_parity(self, env1, monkeypatch):
+        ops = _random_stream(np.random.default_rng(10), 4, 16)
+
+        def run():
+            dq = qt.createDensityQureg(4, env1)
+            return _apply_stream(dq, ops)
+
+        monkeypatch.setenv("QT_PERM_FAST", "on")
+        a_on = run()
+        monkeypatch.setenv("QT_PERM_FAST", "off")
+        a_off = run()
+        np.testing.assert_allclose(a_on, a_off, atol=1e-10, rtol=0)
+
+    def test_resume_seeded_measurement_parity(self, env8, monkeypatch,
+                                              tmp_path):
+        n = 6
+        gates = []
+        for t in range(n):
+            gates.append(CIRC.Gate((t,), H_SOA))
+        for t in range(n - 1):
+            gates.append(CIRC.Gate((t, t + 1), CX_SOA))
+        gates.append(CIRC.Gate((0, n - 1), SWAP_SOA))
+        gates.append(CIRC.Gate((2,), X_SOA))
+
+        def run(flag, d):
+            monkeypatch.setenv("QT_PERM_FAST", flag)
+            qt.seedQuEST(env8, [7, 9])
+            q = qt.createQureg(n, env8)
+            qt.run_resumable(q, gates, str(tmp_path / d), every=2)
+            a = np.asarray(q.amps)
+            m = [qt.measure(q, t) for t in range(3)]
+            return a, m
+
+        a_on, m_on = run("on", "on")
+        a_off, m_off = run("off", "off")
+        np.testing.assert_allclose(a_on, a_off, atol=1e-10, rtol=0)
+        assert m_on == m_off
+
+    def test_optimizer_suppressed_windowed_path(self, env8, monkeypatch,
+                                                tmp_path):
+        """The family survives run_resumable's windowed executor with the
+        optimizer suppressed (resilience drains must stay unrewritten)."""
+        monkeypatch.setenv("QT_OPTIMIZER", "off")
+        gates = [CIRC.Gate((0,), H_SOA), CIRC.Gate((0, 1), CX_SOA),
+                 CIRC.Gate((1, 2), CX_SOA), CIRC.Gate((0, 3), SWAP_SOA)]
+
+        def run(flag, d):
+            monkeypatch.setenv("QT_PERM_FAST", flag)
+            qt.seedQuEST(env8, [3])
+            q = qt.createQureg(5, env8)
+            qt.run_resumable(q, gates, str(tmp_path / d), every=1)
+            return np.asarray(q.amps)
+
+        np.testing.assert_allclose(run("on", "a"), run("off", "b"),
+                                   atol=1e-10, rtol=0)
+
+
+class TestRelabelZeroCollective:
+    def test_local_relabel_folds_and_compiles_collective_free(
+            self, env8, tele):
+        """SWAP-only stream on shard-LOCAL bits: the whole drain folds
+        into the lazy perm (zero window exchanges, zero dispatched
+        parts) and the deferred canonical-read remap compiles to ZERO
+        collectives."""
+        n = 6
+        nloc = n - 3  # 8 shards -> 3 shard bits
+        q = _make_state(env8, n, kind="debug")
+        qt.startGateFusion(q)
+        qt.swapGate(q, 0, 1)
+        qt.swapGate(q, 1, 2)
+        qt.swapGate(q, 0, 2)
+        rep = qt.explainCircuit(q)
+        assert any(w["kind"] == "relabel" for w in rep["windows"])
+        c0 = T.counter_sum("exchanges_total", op="window_remap")
+        _ = q._amps_raw()  # drain WITHOUT the canonical-read remap
+        assert T.counter_sum("exchanges_total", op="window_remap") == c0
+        assert T.counter_sum("permutation_gates_total",
+                             route="relabel") >= 1
+        assert T.counter_sum("permutation_gates_total",
+                             route="exchange") == 0
+        perm = q._perm
+        assert perm is not None
+        assert all(perm[b] == b for b in range(nloc, n))  # shard bits idle
+
+        def canonical_read(a):
+            return PAR.remap_sharded(
+                a, mesh=env8.mesh, num_qubits=n,
+                sigma=PAR.canonical_sigma(perm))
+
+        with qt.CollectiveBudget(exact={}):
+            audit = qt.audit(canonical_read, q._amps)
+        assert sum(audit.collectives.values()) == 0
+        # and the fold is still the right answer
+        ref = np.asarray(_make_state(env8, n, kind="debug").amps)
+        got = np.asarray(q.amps)
+        want = ref[:, _relabel_index(n, ((0, 1), (1, 2), (0, 2)))]
+        assert np.array_equal(got, want)
+
+    def test_cross_shard_fold_defers_exchange(self, env8, tele,
+                                              monkeypatch):
+        """A SWAP touching a shard bit still folds (zero window parts);
+        the composed ppermute is deferred to the canonical read and the
+        route is counted as exchange."""
+        n = 6
+        q = _make_state(env8, n, kind="debug")
+        with qt.gateFusion(q):
+            qt.swapGate(q, 0, n - 1)  # bit 5 lives on the shard axis
+        c_win = T.counter_sum("exchanges_total", op="window_remap")
+        assert c_win == 0
+        assert T.counter_sum("permutation_gates_total",
+                             route="exchange") >= 1
+        a_on = np.asarray(q.amps)
+        monkeypatch.setenv("QT_PERM_FAST", "off")
+        q2 = _make_state(env8, n, kind="debug")
+        with qt.gateFusion(q2):
+            qt.swapGate(q2, 0, n - 1)
+        assert np.array_equal(a_on, np.asarray(q2.amps))
+
+
+def _relabel_index(n, swaps):
+    """Amplitude gather indices equivalent to a sequence of qubit swaps
+    applied to the state (new[i] = old[src[i]])."""
+    perm = list(range(n))
+    for a, b in swaps:
+        perm[a], perm[b] = perm[b], perm[a]
+    idx = np.arange(1 << n)
+    src = np.zeros_like(idx)
+    for bit in range(n):
+        src |= (((idx >> perm[bit]) & 1) << bit)
+    return src
+
+
+class TestSparseInit:
+    def test_round_trip_vs_set_amps(self, env1):
+        n, k = 6, 7
+        rng = np.random.default_rng(5)
+        idx = np.sort(rng.choice(1 << n, size=k, replace=False))
+        vals = rng.standard_normal(k) + 1j * rng.standard_normal(k)
+        vals /= np.linalg.norm(vals)
+        q1 = qt.createQureg(n, env1)
+        qt.initSparseState(q1, idx, vals)
+        re = np.zeros(1 << n)
+        im = np.zeros(1 << n)
+        re[idx], im[idx] = vals.real, vals.imag
+        q2 = qt.createQureg(n, env1)
+        qt.setAmps(q2, 0, re, im, 1 << n)
+        assert np.array_equal(np.asarray(q1.amps), np.asarray(q2.amps))
+
+    def test_round_trip_sharded(self, env8):
+        n = 6
+        idx = [1, 17, 40, 63]
+        vals = np.array([0.6, 0.48j, -0.48, 0.4])
+        q1 = qt.createQureg(n, env8)
+        qt.initSparseState(q1, idx, vals)
+        re = np.zeros(1 << n)
+        im = np.zeros(1 << n)
+        re[idx], im[idx] = vals.real, vals.imag
+        q2 = qt.createQureg(n, env8)
+        qt.initStateFromAmps(q2, re, im)
+        assert np.array_equal(np.asarray(q1.amps), np.asarray(q2.amps))
+
+    def test_clustered_state(self, env1):
+        q = qt.createQureg(5, env1)
+        blocks = [[0.5, 0.5], [0.5j, -0.5]]
+        qt.initSparseClusteredState(q, [4, 20], blocks)
+        a = np.asarray(q.amps)
+        dense = np.zeros(32, dtype=np.complex128)
+        dense[4:6] = blocks[0]
+        dense[20:22] = blocks[1]
+        assert np.array_equal(a[0] + 1j * a[1], dense)
+
+    def test_admits_at_sparse_cost_and_densifies_lazily(
+            self, env1, fresh_gov, tele):
+        n = 10
+        q = qt.createQureg(n, env1)
+        per = G.register_bytes_per_device(q)
+        fresh_gov.setenv("QT_HBM_BUDGET_BYTES", str(int(per * 1.5)))
+        qt.initSparseState(q, [0, 3], [0.6, 0.8])
+        assert q._amps is None and q._spill is not None
+        assert G.resident_bytes() == 0  # no dense footprint admitted
+        # a second DENSE register still fits: the sparse one holds no HBM
+        q2 = qt.createQureg(n, env1)
+        qt.initZeroState(q2)
+        a = np.asarray(q.amps)  # first touch densifies under admission
+        assert a[0, 0] == 0.6 and a[0, 3] == 0.8
+        assert abs(np.abs(a).sum() - 1.4) < 1e-12
+        assert T.counter_total("sparse_inits_total") == 1
+        assert T.counter_sum("dispatch_total", family="permutation") >= 1
+
+    def test_rejects_when_even_sparse_does_not_fit(self, env1, fresh_gov):
+        q = qt.createQureg(8, env1)
+        fresh_gov.setenv("QT_HBM_BUDGET_BYTES", "16")
+        with pytest.raises(qt.MemoryAdmissionError):
+            qt.initSparseState(q, [0, 1, 2, 3], np.ones(4) / 2.0)
+
+    def test_checkpoint_resume_bit_identity(self, env8, tmp_path):
+        n = 6
+        gates = [CIRC.Gate((t,), H_SOA) for t in range(4)]
+        gates.append(CIRC.Gate((0, 5), SWAP_SOA))
+        qt.seedQuEST(env8, [11])
+        q = qt.createQureg(n, env8)
+        qt.initSparseClusteredState(q, [4, 40], [[0.6], [0.8j]])
+        qt.run_resumable(q, gates, str(tmp_path / "ck"), every=1)
+        a = np.asarray(q.amps)
+        qt.seedQuEST(env8, [11])
+        q2 = qt.createQureg(n, env8)
+        qt.run_resumable(q2, gates, str(tmp_path / "ck"), every=1)
+        assert np.array_equal(a, np.asarray(q2.amps))
+
+    def test_validation_errors(self, env1):
+        q = qt.createQureg(4, env1)
+        with pytest.raises(qt.QuESTError, match="duplicate"):
+            qt.initSparseState(q, [3, 3], [0.5, 0.5])
+        with pytest.raises(qt.QuESTError, match="Invalid amplitude"):
+            qt.initSparseState(q, [16], [1.0])
+        with pytest.raises(qt.QuESTError, match="non-empty"):
+            qt.initSparseState(q, [], [])
+        dq = qt.createDensityQureg(2, env1)
+        with pytest.raises(qt.QuESTError):
+            qt.initSparseState(dq, [0], [1.0])
+
+
+class TestExplainAndTelemetry:
+    def test_explain_scalar_perm_window_kind(self, env1, monkeypatch):
+        monkeypatch.setenv("QT_OPTIMIZER", "off")
+        q = qt.createQureg(6, env1)
+        qt.startGateFusion(q)
+        qt.pauliX(q, 0)
+        qt.controlledNot(q, 0, 1)
+        qt.swapGate(q, 2, 3)
+        rep = qt.explainCircuit(q)
+        kinds = [w["kind"] for w in rep["windows"]]
+        assert "perm" in kinds
+        assert rep["totals"]["perm_windows"] >= 1
+        txt = rep.table()
+        assert "perm" in txt and "perm_windows=" in txt
+        _ = q.amps  # drain the buffer so the register is left clean
+
+    def test_swap_scalar_single_permute_kernel(self, env1, monkeypatch,
+                                               tele):
+        q = qt.createQureg(5, env1)
+        qt.initDebugState(q)
+        ref = np.asarray(q.amps)
+        monkeypatch.setattr(F, "capture_unitary",
+                            lambda *a, **k: False)
+        calls = {"permute": 0, "swap": 0}
+        orig_p, orig_s = K.permute_qubits, K.swap_qubit_amps
+
+        def spy_p(*a, **k):
+            calls["permute"] += 1
+            return orig_p(*a, **k)
+
+        def spy_s(*a, **k):
+            calls["swap"] += 1
+            return orig_s(*a, **k)
+
+        monkeypatch.setattr(K, "permute_qubits", spy_p)
+        monkeypatch.setattr(K, "swap_qubit_amps", spy_s)
+        qt.swapGate(q, 1, 3)
+        assert calls == {"permute": 1, "swap": 0}
+        assert T.counter_sum("permutation_gates_total",
+                             route="relabel") == 1
+        got = np.asarray(q.amps)
+        assert np.array_equal(got, ref[:, _relabel_index(5, ((1, 3),))])
+        # the off arm keeps the legacy pairwise kernel
+        monkeypatch.setenv("QT_PERM_FAST", "off")
+        qt.swapGate(q, 1, 3)
+        assert calls["permute"] == 1 and calls["swap"] >= 1
+        assert np.array_equal(np.asarray(q.amps), ref)
+
+    def test_route_counters_and_env_string(self, env1, tele, monkeypatch):
+        # optimizer off: a 2-gate perm run would otherwise coalesce into
+        # a singleton, which rides the dense path by design
+        monkeypatch.setenv("QT_OPTIMIZER", "off")
+        q = qt.createQureg(5, env1)
+        qt.initSparseState(q, [0], [1.0])
+        with qt.gateFusion(q):
+            qt.pauliX(q, 0)
+            qt.controlledNot(q, 0, 1)
+        _ = q.amps
+        assert T.counter_sum("dispatch_total", family="permutation") >= 1
+        routes = {r: T.counter_sum("permutation_gates_total", route=r)
+                  for r in ("relabel", "gather", "exchange")}
+        assert sum(routes.values()) >= 1
+        s = qt.getEnvironmentString(env1)
+        assert "PermFast=on" in s
+        rep = T.perf_report()
+        assert "permutation fast paths" in rep
+        assert "sparse inits: 1" in rep
+
+    def test_env_string_shows_disabled_flag(self, env1, monkeypatch,
+                                            tele):
+        monkeypatch.setenv("QT_PERM_FAST", "off")
+        assert "PermFast=off" in qt.getEnvironmentString(env1)
