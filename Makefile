@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-mega verify-regress bench docs clean
 
 all: native
 
@@ -74,9 +74,21 @@ verify-sparse:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_permfast.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_sparse.py
 
+# Window megakernel (docs/design.md §29): the parity/fallback/routing
+# contract suite plus the QT_MEGAKERNEL on/off A/B — scalar run gates
+# >= 1.3x on the dense-window drain (parity <= 1e-10, drift == 0 both
+# arms); the 8-device dryrun re-checks parity/drift/routing on the
+# SHARDED dispatch path (--floor 0: the overhead win is calibrated
+# single-device).  The speedup joins the regression trajectory as
+# bench_suite config 17.
+verify-mega:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_megakernel.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu python scripts/bench_megakernel.py
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_megakernel.py --n 18 --depth 3 --reps 1 --floor 0
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static verify-serve verify-optimizer verify-chaos verify-sparse
+verify: verify-static verify-serve verify-optimizer verify-chaos verify-sparse verify-mega
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

@@ -183,9 +183,9 @@ def config2(env):
     fn, us = circuits.build_random_circuit(N, DEPTH, seed=7)
     num_gates = DEPTH * N + sum(
         1 for d in range(DEPTH) for t in range(N - 1) if (d + t) % 2 == 0)
-    ops = C.plan_to_device(
-        C.plan_circuit(circuits.bench_gate_list(N, DEPTH, np.asarray(us)), N),
-        jnp.float32)
+    plan = C.plan_circuit(circuits.bench_gate_list(N, DEPTH, np.asarray(us)), N)
+    pstats = C.stats(plan)
+    ops = C.plan_to_device(plan, jnp.float32)
     prob_box = [None]
 
     def run_k(k):
@@ -218,10 +218,20 @@ def config2(env):
     # report null rather than a clamped absurdity
     rate = (num_gates * float(1 << N) / st["median"]
             if st["median"] > 0 else None)
+    from quest_tpu.ops import fused as _fused
+
     return {"metric": f"{N}q depth-{DEPTH} random circuit",
             "kdiff": st, "gates": num_gates,
             "amp_updates_per_sec": rate,
             "sustained_k16_dispatch_bound": sustained,
+            # dispatch-count breakdown (r04->r05 diagnosis + §29): the
+            # number of separately dispatched programs one iteration
+            # chains — the host-dispatch-bound regime's lever arm — and
+            # how many the megakernel planner grouped away
+            "programs_per_iter": len(plan),
+            "megakernel": _fused.megakernel_mode(),
+            "megawin_groups": pstats.get("megawin", 0),
+            "megawin_grouped_ops": pstats.get("megawin_ops", 0),
             "prob_check": prob_box[0]}
 
 
@@ -405,6 +415,12 @@ def main():
     value = c2.get("amp_updates_per_sec")   # the rate uses the median
     baseline_shape = (N == 26 and DEPTH == 20) and value is not None
     summary = {
+        # "config" keys the line into scripts/bench_regress.py's
+        # JSON-lines normalizer — the machine-parsable contract that
+        # replaced re-grepping the text tail (a r05 parsed:null artifact
+        # came from the old everything-on-one-line stdout outgrowing the
+        # capture window)
+        "config": 2,
         "metric": f"{N}q depth-{DEPTH} random-circuit gate-apply rate",
         "value": value,
         "unit": "amp_updates_per_sec",
@@ -413,6 +429,9 @@ def main():
         "seconds": best,
         "seconds_median": c2.get("kdiff", {}).get("median"),
         "seconds_spread": c2.get("kdiff", {}).get("spread"),
+        "programs_per_iter": c2.get("programs_per_iter"),
+        "megakernel": c2.get("megakernel"),
+        "megawin_groups": c2.get("megawin_groups"),
         "backend": jax.default_backend(),
         "total_bench_s": round(time.time() - t_start, 1),
     }

@@ -793,10 +793,44 @@ def config16():
            "max_abs_err": w["sparse"]["max_abs_err"]})
 
 
+def config17():
+    """Window megakernel (ISSUE 18 / docs/design.md §29):
+    QT_MEGAKERNEL=on vs off on the dense-window drain
+    (scripts/bench_megakernel.py).  One timing line —
+    ``megakernel_speedup_x``, the chained-plan device marginal of the
+    off arm over the on arm — with bit-parity, drift==0-both-arms, and
+    megawin-routing checks in tow."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_megakernel
+
+    t0 = time.perf_counter()
+    # devices=1 under the CPU smoke mesh: sharding 14q across the 8
+    # virtual devices leaves nloc below the fused-window size, so the
+    # drain-half routing telemetry would be vacuous
+    rec = bench_megakernel.run(n=14 if CPU else 22,
+                               depth=60 if CPU else 40,
+                               devices=1 if CPU else None)
+    _set_compile(0.0)  # both arms warm inside run()
+    seconds = round(time.perf_counter() - t0, 3)
+    _emit(17, f"{rec['n']}q dense-window megakernel A/B speedup",
+          rec["megakernel_speedup_x"], "megakernel_speedup_x", seconds,
+          {"max_abs_err": rec["max_abs_err"],
+           "drift": rec["drain"]["on"]["drift"]
+           + rec["drain"]["off"]["drift"],
+           "programs_per_iter_off":
+           rec["plan"]["off"]["programs_per_iter"],
+           "programs_per_iter_on": rec["plan"]["on"]["programs_per_iter"],
+           "megawin_groups": rec["plan"]["on"]["megawin_groups"],
+           "mega_dispatches": rec["drain"]["on"]["mega_dispatches"],
+           "hbm_round_trips_per_window":
+           rec["drain"]["on"]["hbm_round_trips_per_window"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16}
+           15: config15, 16: config16, 17: config17}
 
 
 def main():

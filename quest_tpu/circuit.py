@@ -1027,6 +1027,79 @@ def split_plan_sides(ops: Sequence[tuple]) -> List[tuple]:
     return out
 
 
+# Matrix operands of one megawin group are ALL VMEM-resident at once
+# (per-pass state temporaries are sequential, the matrices are not), so the
+# group closes when their total passes this budget — 4 MB leaves the
+# 16 MB scoped VMEM room for the G-row state block in+out plus the active
+# pass's temporaries at the megawin_row_cap sizing.
+MEGA_MAT_BYTES = 4 << 20
+
+
+def _winfused_mat_bytes(op) -> int:
+    """f32 VMEM bytes of one winfused pass's matrix operands as the
+    megakernel stages them (dual-side passes upload 256x256 real reps)."""
+    rank = int(np.shape(op[2])[0])
+    dual = op[4] and op[5]
+    per = 2 * (2 * DIM) * (2 * DIM) * 4 if dual else 2 * 2 * DIM * DIM * 4
+    nbytes = rank * per
+    if len(op) > 6 and op[6] is not None:
+        nbytes += 2 * DIM * DIM * 4
+    return nbytes
+
+
+def group_megawins(ops: Sequence[tuple], num_qubits: int) -> List[tuple]:
+    """Megakernel grouping rewrite (docs/design.md §29): fold each run of
+    consecutive winfused passes into ``("megawin", (passes...))`` groups
+    that execute as ONE pallas_call — one HBM round-trip for the run.
+
+    A pass joins the open group while the group stays inside the VMEM
+    budget: G = 2^(kmax-7) block rows (every member's window bits must be
+    block-local) can't exceed any member's row cap
+    (fused.megawin_row_cap), the shard's row count, or the matrix-operand
+    budget (MEGA_MAT_BYTES).  Wider-window passes (k > 10 at the default
+    caps) stay on the per-pass route — already one HBM trip each.
+    Groups of one are pointless and left ungrouped."""
+    if num_qubits < WINDOW:
+        return list(ops)
+    nb = 1 << (num_qubits - WINDOW)
+    out: List[tuple] = []
+    group: List[tuple] = []
+    kmax = allowed = mat_bytes = 0
+
+    def close():
+        nonlocal group, kmax, allowed, mat_bytes
+        if len(group) >= 2:
+            out.append(("megawin", tuple(group)))
+        else:
+            out.extend(group)
+        group, kmax, allowed, mat_bytes = [], 0, 0, 0
+
+    for op in ops:
+        if op[0] != "winfused":
+            close()
+            out.append(op)
+            continue
+        cap = min(fused.megawin_row_cap(int(np.shape(op[2])[0]),
+                                        num_qubits), nb)
+        nbytes = _winfused_mat_bytes(op)
+        if (1 << (op[1] - LANE)) > cap:
+            close()
+            out.append(op)           # window too wide to ever be grouped
+            continue
+        if group:
+            nk = max(kmax, op[1])
+            na = min(allowed, cap)
+            if ((1 << (nk - LANE)) <= na
+                    and mat_bytes + nbytes <= MEGA_MAT_BYTES):
+                group.append(op)
+                kmax, allowed, mat_bytes = nk, na, mat_bytes + nbytes
+                continue
+            close()
+        group, kmax, allowed, mat_bytes = [op], op[1], cap, nbytes
+    close()
+    return out
+
+
 def plan_circuit(gates: Sequence[Gate], num_qubits: int,
                  use_native: Optional[bool] = None,
                  planner: Optional[str] = None) -> List[tuple]:
@@ -1063,6 +1136,8 @@ def plan_circuit(gates: Sequence[Gate], num_qubits: int,
             ops = plan_circuit_windowed(gates, num_qubits)
         if _side_split_enabled() and num_qubits >= WINDOW:
             ops = split_plan_sides(ops)
+        if fused.megakernel_planning() and num_qubits >= WINDOW:
+            ops = group_megawins(ops, num_qubits)
         return ops
     if use_native is None:
         use_native = native.native_available()
@@ -1643,6 +1718,18 @@ def execute_plan(amps, ops: Sequence[tuple], num_qubits: int,
                 num_qubits=n, k=op[1], apply_a=op[4], apply_b=op[5],
                 interpret=interpret, precision=precision,
             )
+        elif op[0] == "megawin":
+            # §29: the fused route when executable on this backend/dtype;
+            # otherwise decompose to the bit-identical per-pass sequence
+            # (the megakernel fallback ladder's bottom rung)
+            if fused.megakernel_executable(amps.dtype):
+                amps = fused.apply_window_megastack(
+                    amps, op[1], num_qubits=n, interpret=interpret,
+                    precision=precision,
+                )
+            else:
+                amps = execute_plan(amps, op[1], n, interpret=interpret,
+                                    precision=precision)
         elif op[0] == "permute":
             amps = kernels.permute_qubits(amps, num_qubits=n, perm=op[1])
         elif op[0] == "xor":
@@ -1713,6 +1800,8 @@ def plan_to_device(ops: Sequence[tuple], dtype) -> List[tuple]:
             out.append(("winfused", op[1], jnp.asarray(op[2], dtype),
                         jnp.asarray(op[3], dtype), op[4], op[5],
                         None if mask is None else jnp.asarray(mask, dtype)))
+        elif op[0] == "megawin":
+            out.append(("megawin", tuple(plan_to_device(op[1], dtype))))
         elif op[0] == "fused":
             out.append(("fused", jnp.asarray(op[1], dtype),
                         jnp.asarray(op[2], dtype)))
@@ -1757,6 +1846,9 @@ def stats(ops: Sequence[tuple]) -> dict:
     c = Counter(op[0] for op in ops)
     return {"fused": c.get("fused", 0), "swapfused": c.get("swapfused", 0),
             "winfused": c.get("winfused", 0),
+            "megawin": c.get("megawin", 0),
+            "megawin_ops": sum(len(op[1]) for op in ops
+                               if op[0] == "megawin"),
             "apply": c.get("apply", 0), "segswap": c.get("segswap", 0),
             "permute": c.get("permute", 0),
             "xor": c.get("xor", 0),
@@ -2024,6 +2116,10 @@ def split_plan(ops: Sequence[tuple]):
             arrays.extend([op[2], op[3]])
             if mask is not None:
                 arrays.append(mask)
+        elif op[0] == "megawin":
+            sub_sk, sub_arrays = split_plan(op[1])
+            skeleton.append(("megawin", sub_sk))
+            arrays.extend(sub_arrays)
         elif op[0] == "apply":
             skeleton.append(("apply", tuple(op[1]), tuple(np.shape(op[2]))))
             arrays.append(op[2])
@@ -2041,13 +2137,18 @@ def split_plan(ops: Sequence[tuple]):
 
 def rebuild_plan(skeleton: Sequence[tuple], arrays: Sequence) -> List[tuple]:
     """Inverse of split_plan given the (possibly traced) array operands."""
-    it = iter(arrays)
+    return _rebuild_plan_iter(skeleton, iter(arrays))
+
+
+def _rebuild_plan_iter(skeleton: Sequence[tuple], it) -> List[tuple]:
     ops: List[tuple] = []
     for sk in skeleton:
         if sk[0] == "winfused":
             a, b = next(it), next(it)
             mask = next(it) if len(sk) > 5 and sk[5] else None
             ops.append(("winfused", sk[1], a, b, sk[3], sk[4], mask))
+        elif sk[0] == "megawin":
+            ops.append(("megawin", tuple(_rebuild_plan_iter(sk[1], it))))
         elif sk[0] == "apply":
             ops.append(("apply", sk[1], next(it)))
         elif sk[0] == "fused":

@@ -387,6 +387,22 @@ def get_environment_string(env: QuESTEnv) -> str:
             if telemetry.counter_sum("permutation_gates_total", route=r))
         if routes:
             s += f"({routes})"
+    # §29 window megakernel (QT_MEGAKERNEL): mode plus the planning
+    # verdict in parentheses, and cumulative per-route dispatch history
+    # once any fused window executed through either arm
+    from .ops import fused as _fused
+
+    mk = _fused.megakernel_mode()
+    mk_total = telemetry.counter_total("megakernel_dispatch_total")
+    if mk != "auto" or _fused.megakernel_planning() or mk_total:
+        s += (f" Megakernel={mk}"
+              f"({'on' if _fused.megakernel_planning() else 'off'})")
+        mk_routes = ",".join(
+            f"{r}:{int(telemetry.counter_sum('megakernel_dispatch_total', route=r))}"
+            for r in ("mega", "fallback")
+            if telemetry.counter_sum("megakernel_dispatch_total", route=r))
+        if mk_routes:
+            s += f"[{mk_routes}]"
     spills = telemetry.counter_total("spills_total")
     if spills:
         s += f" Spills={int(spills)}"

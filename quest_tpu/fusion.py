@@ -153,9 +153,15 @@ def _plan_key(items, nloc: int, sweep_ok: bool, perm0=None, nsh: int = 0):
         topo_sig = None
     # QT_PERM_FAST is part of the key: flipping it reroutes permutation
     # runs between the gather/relabel lowering and the dense matmul
-    # pipeline, so a flip must retrace rather than replay a stale plan
+    # pipeline, so a flip must retrace rather than replay a stale plan.
+    # QT_MEGAKERNEL likewise: the grouping rewrite (§29) changes the plan
+    # skeleton itself, so a knob flip must re-plan rather than replay a
+    # plan grouped under the other mode.
+    from .ops import fused as _fused
+
     return (nloc, sweep_ok, perm0, topo_sig, _opt.mode(),
-            C.perm_fast_enabled(), tuple(parts))
+            C.perm_fast_enabled(), _fused.megakernel_planning(),
+            tuple(parts))
 
 
 def _split_items(items, nloc: int, sweep_ok: bool):
@@ -265,7 +271,13 @@ def _item_entry(it):
     cost-model consumer — the sharded planner here, optimizer._stream_cost,
     introspect.explain_circuit, and the §21 reconciliation — builds its
     entries through this one function, so predictions and the dispatched
-    plan price the same stream and model drift stays 0 by construction."""
+    plan price the same stream and model drift stays 0 by construction.
+    The §29 megakernel regroups the planner's winfused ops AFTER entries
+    are priced (circuit.group_megawins is a pure post-pass inside the
+    local plan segment): it changes how many Pallas dispatches execute a
+    window, never which amplitudes move between shards, so every entry —
+    and therefore the §21 reconciliation and §22 drain-peak predictor —
+    prices both QT_MEGAKERNEL arms identically by construction."""
     if isinstance(it, ChannelItem):
         return (it.target, it.bra)
     return C.perm_item_entry(it.targets, it.mat)
@@ -416,6 +428,33 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
     if _telemetry.enabled():
         _telemetry.inc("fusion_windows_total",
                        sum(1 for p in program if p[0] == "plan"))
+        # §29 megakernel route accounting: one "mega" per megawin group
+        # (ONE pallas_call = one HBM round-trip for its whole run), one
+        # "fallback" per winfused pass still on the per-pass route while
+        # grouping is active.  The gauge is the drain's mean HBM
+        # round-trips per fusion window — the quantity the megakernel
+        # exists to shrink.
+        from .ops import fused as _fusedops
+
+        mega = fallback = trips = plan_parts = 0
+        for part in program:
+            if part[0] != "plan":
+                continue
+            plan_parts += 1
+            for sk in part[1]:
+                trips += 1
+                if sk[0] == "megawin":
+                    mega += 1
+                elif sk[0] == "winfused":
+                    fallback += 1
+        if mega:
+            _telemetry.inc("megakernel_dispatch_total", mega, route="mega")
+        if fallback and _fusedops.megakernel_planning():
+            _telemetry.inc("megakernel_dispatch_total", fallback,
+                           route="fallback")
+        if plan_parts:
+            _telemetry.set_gauge("window_hbm_round_trips",
+                                 trips / plan_parts)
         # permutation-family route accounting (§28): lowered window ops
         # count by kind (one coalesced transpose = relabel, static
         # xor/gather passes = gather); sharded relabel FOLDS — which

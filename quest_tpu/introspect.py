@@ -91,30 +91,46 @@ def _as_items(gates) -> list:
     return items
 
 
-def _segment_stats(items) -> tuple:
-    """(plan_windows, gates, channels, perm_windows) for one item run
-    under fusion._split_items's segmentation: each maximal consecutive
-    gate run splits into permutation runs (§28 — their own ("perm", ...)
-    parts, which fusion_windows_total does NOT count) and dense runs
-    that fold into ONE ("plan", ...) part each; channels emit
-    chan/chansweep parts, also uncounted."""
+def _segment_stats(items, nloc=None, perm=None) -> tuple:
+    """(plan_windows, gates, channels, perm_windows, mega_windows,
+    mega_ops) for one item run under fusion._split_items's segmentation:
+    each maximal consecutive gate run splits into permutation runs (§28
+    — their own ("perm", ...) parts, which fusion_windows_total does NOT
+    count) and dense runs that fold into ONE ("plan", ...) part each;
+    channels emit chan/chansweep parts, also uncounted.  When ``nloc``
+    is given and the §29 megakernel planner is active, each dense run is
+    additionally planned through circuit.plan_circuit — the exact local
+    planner the drain dispatches — to count megawin groups and the
+    winfused ops they absorb; ``perm`` first rewrites logical targets to
+    their physical shard-local bits, mirroring the sharded dispatcher's
+    own rewrite."""
     from . import fusion as F
+    from .ops import fused as _fused
 
+    count_mega = (nloc is not None and nloc >= C.WINDOW
+                  and _fused.megakernel_planning())
     plan_parts = 0
     perm_parts = 0
     gates = 0
     chans = 0
+    mega_groups = 0
+    mega_ops = 0
     seg: list = []
 
     def flush():
-        nonlocal plan_parts, perm_parts
+        nonlocal plan_parts, perm_parts, mega_groups, mega_ops
         if not seg:
             return
-        for kind, _sub in F._perm_runs(seg):
+        for kind, sub in F._perm_runs(seg):
             if kind == "perm":
                 perm_parts += 1
             else:
                 plan_parts += 1
+                if count_mega:
+                    for op in C.plan_circuit(list(sub), nloc):
+                        if op[0] == "megawin":
+                            mega_groups += 1
+                            mega_ops += len(op[1])
         seg.clear()
 
     for it in items:
@@ -123,9 +139,11 @@ def _segment_stats(items) -> tuple:
             flush()
         else:
             gates += 1
+            if perm is not None:
+                it = C.Gate(tuple(perm[t] for t in it.targets), it.mat)
             seg.append(it)
     flush()
-    return plan_parts, gates, chans, perm_parts
+    return plan_parts, gates, chans, perm_parts, mega_groups, mega_ops
 
 
 def _sigma_cost(sigma, n: int, nloc: int, nsh: int, itemsize: int,
@@ -293,6 +311,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
     tot_tier = {"ici": 0, "dcn": 0}
     plan_windows = 0
     perm_windows = 0
+    mega_windows = 0
     if nsh and items:
         entries = [F._item_entry(it) for it in items]
         segments, final_perm = C.plan_remap_windows(entries, n, nloc, perm0)
@@ -304,17 +323,23 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                 windows.append({"window": k, "start": int(i), "end": int(j),
                                 "gates": j - i, "channels": 0,
                                 "plan_windows": 0, "perm_windows": 0,
+                                "mega_windows": 0, "mega_ops": 0,
                                 "kind": "relabel", "sigma": None,
                                 "exchanges": 0, "exchange_bytes": 0,
                                 "chunks": None})
                 continue
-            parts, ngates, nchans, pparts = _segment_stats(items[i:j])
+            parts, ngates, nchans, pparts, mparts, mops = _segment_stats(
+                items[i:j], nloc=nloc, perm=_perm)
             plan_windows += parts
             perm_windows += pparts
+            mega_windows += mparts
             entry = {"window": k, "start": int(i), "end": int(j),
                      "gates": ngates, "channels": nchans,
                      "plan_windows": parts, "perm_windows": pparts,
-                     "kind": "perm" if parts == 0 and pparts else "dense",
+                     "mega_windows": mparts, "mega_ops": mops,
+                     "kind": ("mega" if mparts
+                              else "perm" if parts == 0 and pparts
+                              else "dense"),
                      "sigma": None,
                      "exchanges": 0, "exchange_bytes": 0, "chunks": None}
             if sigma is not None:
@@ -341,15 +366,19 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
                 final_remap["tier_exchanges"][t] *= bw
             final_remap["final_perm"] = [int(p) for p in final_perm]
     else:
-        parts, ngates, nchans, pparts = _segment_stats(items)
+        parts, ngates, nchans, pparts, mparts, mops = _segment_stats(
+            items, nloc=nloc)
         plan_windows = parts
         perm_windows = pparts
+        mega_windows = mparts
         if items:
             windows.append({"window": 0, "start": 0, "end": len(items),
                             "gates": ngates, "channels": nchans,
                             "plan_windows": parts, "perm_windows": pparts,
-                            "kind": "perm" if parts == 0 and pparts
-                            else "dense",
+                            "mega_windows": mparts, "mega_ops": mops,
+                            "kind": ("mega" if mparts
+                                     else "perm" if parts == 0 and pparts
+                                     else "dense"),
                             "sigma": None,
                             "exchanges": 0, "exchange_bytes": 0,
                             "chunks": None})
@@ -392,6 +421,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
             "windows": len(windows),
             "plan_windows": int(plan_windows),
             "perm_windows": int(perm_windows),
+            "mega_windows": int(mega_windows),
             "exchanges": int(tot_exch),
             "exchange_bytes": int(tot_bytes),
             "exchanges_with_read": int(tot_exch + read_exch),
@@ -464,6 +494,8 @@ def format_explain(report: dict) -> str:
         f"totals: plan_windows={t['plan_windows']}"
         + (f" perm_windows={t['perm_windows']}"
            if t.get("perm_windows") else "")
+        + (f" mega_windows={t['mega_windows']}"
+           if t.get("mega_windows") else "")
         + f" exchanges={t['exchanges']} bytes={t['exchange_bytes']}"
         + (f" (+{t['exchanges_with_read'] - t['exchanges']} exch / "
            f"+{t['exchange_bytes_with_read'] - t['exchange_bytes']} bytes "

@@ -289,6 +289,61 @@ def _apply_swap_cluster_stack_jit(
     return out.reshape(in_shape)
 
 
+def _window_block_body(x, ma, mb, mask, rank, apply_a, apply_b, prec):
+    """Shared window-pass algebra on one VMEM-resident 5-d value
+    (2, R, 128, M, 128) — window index on axis 2, lanes on axis 4, R/M
+    pure batch axes.  Used verbatim by both the single-pass kernel
+    (_window_kernel) and the megakernel (_mega_window_kernel) so the two
+    routes issue IDENTICAL dot_generals in identical order and stay
+    bit-exact against each other (tests/test_megakernel.py pins this)."""
+    xr, xi = x[0], x[1]
+    if apply_a and apply_b:
+        # both sides: the lane-concat real rep keeps each side ONE
+        # 256-contraction (beats 4 separate 128-dots per side,
+        # measured both rounds)
+        xc0 = jnp.concatenate([xr, xi], axis=-1)     # (R, 128, M, 256)
+        acc = None
+        for r in range(rank):
+            xc = _kdot(xc0, ma[r], (((3,), (0,)), ((), ())), prec)                                        # (R, 128, M, 256)
+            yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
+            # sublane op: left-contract the window axis (dim 1)
+            yc = jnp.concatenate([yr, yi], axis=1)   # (R, 256, M, 128)
+            out = _kdot(mb[r], yc, (((1,), (1,)), ((), ())), prec)                                        # (256, R, M, 128)
+            out = jnp.moveaxis(out, 0, 1)            # (R, 256, M, 128)
+            acc = out if acc is None else acc + out
+        rr, ri = acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]
+    elif apply_b:
+        # B-only: separate-channel dots — skips the lane concat AND
+        # the lane slice the generic path paid for nothing
+        # (measured ~20-30% faster per pass at 26q)
+        rr = ri = None
+        for r in range(rank):
+            br, bi = mb[r, 0], mb[r, 1]
+            db = (((1,), (1,)), ((), ()))
+            pr = _kdot(br, xr, db, prec) - _kdot(bi, xi, db, prec)
+            pi = _kdot(br, xi, db, prec) + _kdot(bi, xr, db, prec)
+            pr = jnp.moveaxis(pr, 0, 1)              # (R, 128, M, 128)
+            pi = jnp.moveaxis(pi, 0, 1)
+            rr = pr if rr is None else rr + pr
+            ri = pi if ri is None else ri + pi
+    else:
+        # A-only: separate-channel right-dots on the lane axis
+        # (y[l'] = sum_l A[l',l] x[l] -> contract the matrix's col dim)
+        rr = ri = None
+        for r in range(rank):
+            ar, ai = ma[r, 0], ma[r, 1]
+            da = (((3,), (1,)), ((), ()))
+            pr = _kdot(xr, ar, da, prec) - _kdot(xi, ai, da, prec)
+            pi = _kdot(xr, ai, da, prec) + _kdot(xi, ar, da, prec)
+            rr = pr if rr is None else rr + pr
+            ri = pi if ri is None else ri + pi
+    if mask is not None:
+        mr = mask[0][:, None, :]                     # (128, 1, 128)
+        mi = mask[1][:, None, :]
+        rr, ri = rr * mr - ri * mi, rr * mi + ri * mr
+    return jnp.stack([rr, ri], axis=0)               # (2, R, 128, M, 128)
+
+
 def _window_kernel(rank, apply_a, apply_b, prec=jax.lax.Precision.HIGHEST,
                    with_mask=False):
     """Kernel applying [mask (.)] sum_r B_r (x) A_r where A_r acts on the
@@ -310,52 +365,10 @@ def _window_kernel(rank, apply_a, apply_b, prec=jax.lax.Precision.HIGHEST,
             2, xflat.shape[1], CLUSTER_DIM,
             -1, CLUSTER_DIM,
         )                               # (2, R, 128, M, 128)
-        xr, xi = x[0], x[1]
-        if apply_a and apply_b:
-            # both sides: the lane-concat real rep keeps each side ONE
-            # 256-contraction (beats 4 separate 128-dots per side,
-            # measured both rounds)
-            xc0 = jnp.concatenate([xr, xi], axis=-1)     # (R, 128, M, 256)
-            acc = None
-            for r in range(rank):
-                xc = _kdot(xc0, ma_ref[r], (((3,), (0,)), ((), ())), prec)                                        # (R, 128, M, 256)
-                yr, yi = xc[..., :CLUSTER_DIM], xc[..., CLUSTER_DIM:]
-                # sublane op: left-contract the window axis (dim 1)
-                yc = jnp.concatenate([yr, yi], axis=1)   # (R, 256, M, 128)
-                out = _kdot(mb_ref[r], yc, (((1,), (1,)), ((), ())), prec)                                        # (256, R, M, 128)
-                out = jnp.moveaxis(out, 0, 1)            # (R, 256, M, 128)
-                acc = out if acc is None else acc + out
-            rr, ri = acc[:, :CLUSTER_DIM], acc[:, CLUSTER_DIM:]
-        elif apply_b:
-            # B-only: separate-channel dots — skips the lane concat AND
-            # the lane slice the generic path paid for nothing
-            # (measured ~20-30% faster per pass at 26q)
-            rr = ri = None
-            for r in range(rank):
-                br, bi = mb_ref[r, 0], mb_ref[r, 1]
-                db = (((1,), (1,)), ((), ()))
-                pr = _kdot(br, xr, db, prec) - _kdot(bi, xi, db, prec)
-                pi = _kdot(br, xi, db, prec) + _kdot(bi, xr, db, prec)
-                pr = jnp.moveaxis(pr, 0, 1)              # (R, 128, M, 128)
-                pi = jnp.moveaxis(pi, 0, 1)
-                rr = pr if rr is None else rr + pr
-                ri = pi if ri is None else ri + pi
-        else:
-            # A-only: separate-channel right-dots on the lane axis
-            # (y[l'] = sum_l A[l',l] x[l] -> contract the matrix's col dim)
-            rr = ri = None
-            for r in range(rank):
-                ar, ai = ma_ref[r, 0], ma_ref[r, 1]
-                da = (((3,), (1,)), ((), ()))
-                pr = _kdot(xr, ar, da, prec) - _kdot(xi, ai, da, prec)
-                pi = _kdot(xr, ai, da, prec) + _kdot(xi, ar, da, prec)
-                rr = pr if rr is None else rr + pr
-                ri = pi if ri is None else ri + pi
-        if with_mask:
-            mr = mask_ref[0][:, None, :]                 # (128, 1, 128)
-            mi = mask_ref[1][:, None, :]
-            rr, ri = rr * mr - ri * mi, rr * mi + ri * mr
-        res = jnp.stack([rr, ri], axis=0)                # (2, R, 128, M, 128)
+        res = _window_block_body(
+            x, ma_ref, mb_ref,
+            mask_ref[...] if with_mask else None,
+            rank, apply_a, apply_b, prec)
         o_ref[...] = res.reshape(xflat.shape)
 
     return kernel
@@ -576,6 +589,244 @@ def apply_cluster_stack(amps, mats_a, mats_b, *, precision=None, **kw):
     """See _apply_cluster_stack_jit."""
     return _apply_cluster_stack_jit(amps, mats_a, mats_b,
                                     precision=_resolved(precision), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Window megakernel (docs/design.md §29): a RUN of window passes in ONE
+# pallas_call — one HBM read + one HBM write for the whole run instead of
+# one round-trip per pass.  Eligible passes have window offset k <= 7 + g
+# where 2^g VMEM-resident canonical rows make every window bit block-local;
+# the in-kernel regroup between passes is a PURE reshape (no transpose):
+# little-endian bit order means merging the (row_lo, sub_hi) axes IS the
+# window index w = row_lo << (14-k) | sub_hi.
+# ---------------------------------------------------------------------------
+
+
+def megakernel_mode() -> str:
+    """QT_MEGAKERNEL knob: "off" (never group), "on" (force, including
+    interpret mode — the CPU test/bench arm), "auto" (default: group and
+    execute fused only on a real TPU with a Mosaic-supported dtype)."""
+    import os
+
+    raw = os.environ.get("QT_MEGAKERNEL", "auto").strip().lower()
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw in ("on", "1", "true", "yes"):
+        return "on"
+    return "auto"
+
+
+# one-shot Mosaic lowering probe, same contract as paulis._PALLAS_OK: a
+# failed compile downgrades every megawin group to the per-pass route for
+# the rest of the process and records itself in the env report.
+_MEGA_OK: dict = {}
+
+
+def _probe_megakernel_lowering() -> None:
+    """Compile (don't run) a representative two-pass megakernel at the
+    largest row grouping the budget rule admits (G = 8, k = 10): Mosaic
+    VMEM overflows and lowering failures both surface at compile time."""
+    n = 17
+    amps = jax.ShapeDtypeStruct((2, 1 << n), jnp.float32)
+    m = jax.ShapeDtypeStruct((1, 2, CLUSTER_DIM, CLUSTER_DIM), jnp.float32)
+    spec = ((LANE_QUBITS, 1, True, True, False),
+            (LANE_QUBITS + 3, 1, False, True, False))
+
+    def f(x, a1, b1, a2, b2):
+        return _apply_megawin_jit(x, a1, b1, a2, b2, num_qubits=n,
+                                  spec=spec, interpret=False)
+
+    jax.jit(f).lower(amps, m, m, m, m).compile()
+
+
+def megakernel_lowering_ok() -> bool:
+    """True when the window megakernel compiles on this backend; cached
+    per process.  On failure, warn once, record the downgrade in the env
+    report, and decompose megawin groups to per-pass dispatches."""
+    hit = _MEGA_OK.get("ok")
+    if hit is not None:
+        return hit
+    try:
+        _probe_megakernel_lowering()
+        ok = True
+    # qlint: allow(broad-except): Mosaic failures span XlaRuntimeError/NotImplementedError/TypeError depending on backend and version; every one means "use the per-pass route" and is recorded in the degradation registry
+    except Exception as e:
+        from .. import resilience
+
+        resilience.record_degradation(
+            "pallas-window-megakernel",
+            "window megakernel failed to compile; megawin groups decompose "
+            f"to per-pass dispatches ({type(e).__name__}: {e})")
+        ok = False
+    _MEGA_OK["ok"] = ok
+    return ok
+
+
+def megakernel_planning() -> bool:
+    """Whether the planner should FORM megawin groups at all.  "auto"
+    groups only when a real TPU backs the process (the interpret-mode
+    expansion of a fused group is *larger* XLA than per-pass dispatch, so
+    CPU keeps the old plans bit-for-bit); QT_MEGAKERNEL=on forces grouping
+    everywhere — the knob tests and the CPU A/B bench arm use."""
+    mode = megakernel_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return not _interpret_default()
+
+
+def megakernel_executable(dtype=None) -> bool:
+    """Whether a megawin group should EXECUTE through the fused kernel.
+    The fallback ladder below "auto" (each rung decomposes the group to
+    the existing per-pass route, bit-identically): non-TPU backend ->
+    interpret mode is slower fused than split; f64 state -> Mosaic can't
+    lower the dots; Mosaic compile failure -> degradation registry."""
+    mode = megakernel_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    if _interpret_default():
+        return False
+    if dtype is not None and jnp.dtype(dtype) == jnp.float64:
+        return False
+    return megakernel_lowering_ok()
+
+
+def megawin_row_cap(rank: int, num_qubits: int) -> int:
+    """Largest VMEM block-row grouping a sub-pass of this rank tolerates,
+    mirroring the empirical scoped-VMEM rules of _apply_window_stack_jit
+    (rank-1 dual-side overflows 16 MB at 16 rows, fits at 8; rank-4 fits
+    at 4; n <= 21 states risk wholesale XLA VMEM promotion, cap 4).  A
+    group's G = 2^(kmax-7) must stay <= min over its sub-passes."""
+    cap = 8 if rank <= 2 else 4
+    if num_qubits <= 21:
+        cap = min(cap, 4)
+    return cap
+
+
+def _mega_window_kernel(spec, prec=jax.lax.Precision.HIGHEST):
+    """Kernel applying a run of window passes to one VMEM-resident block
+    of G consecutive canonical rows.  ``spec``: per-pass statics
+    (k, rank, apply_a, apply_b, with_mask).  Each pass regroups the block
+    (2, G, 128, 128) -> (2, G/2^(k-7), 128, 2^(k-7), 128) by reshape only
+    (the merged (row_lo, sub_hi) axis IS the window index — little-endian
+    flat order), runs the SAME block body as the per-pass kernel
+    (_window_block_body, so numerics are bit-identical), and reshapes
+    back for the next pass.  One HBM read + one write for the whole run."""
+
+    def kernel(a_ref, *refs):
+        o_ref = refs[-1]
+        x = a_ref[...]                       # (2, G, 128, 128)
+        g_rows = x.shape[1]
+        ri = 0
+        for (k, rank, apply_a, apply_b, with_mask) in spec:
+            ma_ref, mb_ref = refs[ri], refs[ri + 1]
+            ri += 2
+            mask = None
+            if with_mask:
+                mask = refs[ri][...]
+                ri += 1
+            wg = 1 << (k - LANE_QUBITS)      # window bits on the row axis
+            whi = CLUSTER_DIM >> (k - LANE_QUBITS)  # ... on the sublanes
+            ghi = g_rows // wg
+            x5 = x.reshape(2, ghi, wg, whi, wg, CLUSTER_DIM)
+            x5 = x5.reshape(2, ghi, CLUSTER_DIM, wg, CLUSTER_DIM)
+            res = _window_block_body(x5, ma_ref, mb_ref, mask,
+                                     rank, apply_a, apply_b, prec)
+            x = res.reshape(2, g_rows, CLUSTER_DIM, CLUSTER_DIM)
+        o_ref[...] = x
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("num_qubits", "spec", "interpret", "precision"),
+         donate_argnums=0)
+def _apply_megawin_jit(
+    amps,
+    *arrays,
+    num_qubits: int,
+    spec: tuple,
+    interpret: bool | None = None,
+    precision: str | None = None,
+):
+    """Apply the window-pass run described by ``spec`` (per-pass statics
+    (k, rank, apply_a, apply_b, with_mask); ``arrays`` = the flattened
+    (a, b[, mask]) operands in pass order) in ONE pallas_call: grid over
+    2^(n-14)/G super-blocks of G = 2^(kmax-7) consecutive canonical rows,
+    so every pass's window bits are block-local.  Result shape = input
+    shape (canonical-view layout notes as in _apply_window_stack_jit)."""
+    n = num_qubits
+    in_shape = amps.shape
+    interpret = _resolve_interpret(interpret, amps)
+    kmax = max(s[0] for s in spec)
+    g_rows = 1 << (kmax - LANE_QUBITS)
+    if n < CLUSTER_QUBITS:
+        raise ValueError(f"megawin needs >= {CLUSTER_QUBITS} qubits")
+    nb = 1 << (n - CLUSTER_QUBITS)
+    if g_rows > nb or any(not (LANE_QUBITS <= s[0] <= n - SUBLANE_QUBITS)
+                          for s in spec):
+        raise ValueError(f"megawin window offsets out of range for n={n}")
+    state_spec = pl.BlockSpec((2, g_rows, CLUSTER_DIM, CLUSTER_DIM),
+                              lambda i: (0, i, 0, 0))
+    in_specs = [state_spec]
+    operands = []
+    ai = 0
+    for (k, rank, apply_a, apply_b, with_mask) in spec:
+        a = jnp.asarray(arrays[ai], amps.dtype)
+        b = jnp.asarray(arrays[ai + 1], amps.dtype)
+        ai += 2
+        if apply_a and apply_b:
+            # dual-side passes consume the 256x256 real representations
+            ma, mb = jax.vmap(lane_real_rep)(a), jax.vmap(sublane_real_rep)(b)
+            mat_spec = (rank, 2 * CLUSTER_DIM, 2 * CLUSTER_DIM)
+        else:
+            # single-side passes consume the raw SoA matrices
+            ma, mb = a, b
+            mat_spec = (rank, 2, CLUSTER_DIM, CLUSTER_DIM)
+        zmap = lambda i, _d=len(mat_spec): (0,) * _d
+        in_specs += [pl.BlockSpec(mat_spec, zmap),
+                     pl.BlockSpec(mat_spec, zmap)]
+        operands += [ma, mb]
+        if with_mask:
+            in_specs.append(pl.BlockSpec((2, CLUSTER_DIM, CLUSTER_DIM),
+                                         lambda i: (0, 0, 0)))
+            operands.append(jnp.asarray(arrays[ai], amps.dtype))
+            ai += 1
+    view = amps.reshape(2, nb, CLUSTER_DIM, CLUSTER_DIM)
+    out = pl.pallas_call(
+        _mega_window_kernel(spec, _resolve_precision(precision)),
+        grid=(nb // g_rows,),
+        in_specs=in_specs,
+        out_specs=state_spec,
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(view, *operands)
+    return out.reshape(in_shape)
+
+
+def apply_window_megastack(amps, subops, *, num_qubits, interpret=None,
+                           precision=None):
+    """Apply a planned run of winfused passes — ``subops`` is a sequence of
+    ("winfused", k, a, b, apply_a, apply_b[, mask]) tuples — as ONE
+    pallas_call (see _apply_megawin_jit).  This is the megawin plan op's
+    fused route; circuit.execute_plan decomposes to per-pass dispatches
+    instead when megakernel_executable() says no."""
+    spec = []
+    arrays = []
+    for op in subops:
+        mask = op[6] if len(op) > 6 else None
+        spec.append((int(op[1]), int(np.shape(op[2])[0]),
+                     bool(op[4]), bool(op[5]), mask is not None))
+        arrays += [op[2], op[3]]
+        if mask is not None:
+            arrays.append(mask)
+    return _apply_megawin_jit(amps, *arrays, num_qubits=num_qubits,
+                              spec=tuple(spec), interpret=interpret,
+                              precision=_resolved(precision))
 
 
 # ---------------------------------------------------------------------------

@@ -574,6 +574,24 @@ def perf_report(env=None) -> str:
             lines.append(
                 f"  sparse inits: {_num(sparse)} "
                 f"(amps={_num(counter_total('sparse_init_amps_total'))})")
+    # §29 window megakernel: per-route dispatch split and the HBM
+    # round-trips the last drain paid per fused plan window
+    mega_n = counter_total("megakernel_dispatch_total")
+    if mega_n:
+        from .ops import fused as _fused
+
+        by_route = " ".join(
+            f"{r}={_num(counter_sum('megakernel_dispatch_total', route=r))}"
+            for r in ("mega", "fallback")
+            if counter_sum("megakernel_dispatch_total", route=r))
+        lines.append(
+            f"window megakernel (§29, mode={_fused.megakernel_mode()}):")
+        lines.append(f"  dispatches: total={_num(mega_n)} {by_route}")
+        trips = gauge_max("window_hbm_round_trips")
+        if trips is not None:
+            lines.append(
+                f"  hbm_round_trips/plan_window={trips:.3g} "
+                f"(1.0 = one read + one write per fused window)")
     pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
     meas_c = counter_sum("exchanges_total", op="window_remap")
     pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")

@@ -65,6 +65,26 @@ def _norm_configs(configs: dict, out: dict) -> None:
                 out[f"config{num}:{variant}"] = (float(sub), True)
 
 
+def _final_json_line(tail: str):
+    """``bench.py`` ends its stdout with ONE machine-parsable JSON
+    summary line (keys: config/value/unit/seconds/backend).  A round
+    whose ``parsed`` payload is None lost the driver's own parse to
+    output truncation — but the final line survives whenever the capture
+    window held the stream's tail, so prefer recovering THAT (an exact
+    parse) over the positional regex sweep below."""
+    for line in reversed((tail or "").splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "unit" in doc and "value" in doc:
+            return doc
+    return None
+
+
 def _recover_from_tail(tail: str) -> dict:
     """A round whose ``parsed`` payload is None lost its final JSON to
     front-truncation of the captured output; the per-config variant
@@ -98,7 +118,17 @@ def normalize_round(record: dict) -> tuple:
         if isinstance(parsed.get("configs"), dict):
             _norm_configs(parsed["configs"], out)
     else:
-        out = _recover_from_tail(record.get("tail") or "")
+        doc = _final_json_line(record.get("tail") or "")
+        if doc is not None:
+            backend = doc.get("backend")
+            unit = doc.get("unit", "")
+            if doc.get("value") is not None and unit:
+                out[f"headline:{unit}"] = (float(doc["value"]),
+                                           _higher_better(unit))
+            if isinstance(doc.get("configs"), dict):
+                _norm_configs(doc["configs"], out)
+        if not out:
+            out = _recover_from_tail(record.get("tail") or "")
     # bench.py's config 2 IS the headline metric (26q depth-20 gate-apply
     # rate): alias it so rounds whose top-level record was truncated away
     # still extend the multi-round headline trajectory
