@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-mega verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-mega verify-obs verify-regress bench docs clean
 
 all: native
 
@@ -85,6 +85,16 @@ verify-mega:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_megakernel.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	env JAX_PLATFORMS=cpu python scripts/bench_megakernel.py
 	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python scripts/bench_megakernel.py --n 18 --depth 3 --reps 1 --floor 0
+
+# Observability front door (docs/design.md §30): request-scoped
+# tracing, the flight recorder, /metrics over live HTTP, and per-op
+# wall-time attribution — the telemetry + serve-resilience suites
+# (which pin the span-tree, flight-dump, and byte-identical /metrics
+# contracts) plus the overhead guard, which now ALSO gates trace mode
+# under the same < 5% budget.
+verify-obs:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py tests/test_serve_resilience.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	python scripts/bench_telemetry.py
 
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.

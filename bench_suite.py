@@ -827,10 +827,92 @@ def config17():
            rec["drain"]["on"]["hbm_round_trips_per_window"]})
 
 
+def config18():
+    """Observability front door (ISSUE 19 / docs/design.md §30): one
+    chaotic serving run with the live HTTP endpoint up — scrapes
+    /metrics and /healthz over the wire, dumps the per-job request
+    traces (tracez span trees) and the incident flight records to a
+    demo directory, and reports trace completeness.  The timing line
+    carries the count of completed jobs whose span trees reconstruct
+    complete, plus the flight-dump reasons and artifact paths."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import json
+    import tempfile
+    import urllib.request
+
+    import chaos_serve as cs
+
+    import quest_tpu as qt
+    from quest_tpu import resilience as R
+    from quest_tpu import serve as S
+    from quest_tpu import telemetry as T
+
+    t0 = time.perf_counter()
+    demo_dir = tempfile.mkdtemp(prefix="qt_obs_demo_")
+    old_dir = os.environ.get("QT_SERVE_FLIGHT_DIR")
+    os.environ["QT_SERVE_FLIGHT_DIR"] = demo_dir
+    try:
+        env = qt.createQuESTEnv()
+        plan_spec, poisoned = cs._schedule(11)
+        server = S.SimServer(env, window=cs.WINDOW, max_batch=4,
+                             retries=4, watchdog=1,
+                             quarantine=(100, 3600.0),
+                             faults=R.FaultPlan(plan_spec))
+        try:
+            host, port = server.serve_http()
+            handles = []
+            for i, (tenant, theta, prio, measure) in enumerate(
+                    cs._trace(11)):
+                handles.append(server.submit(
+                    cs._circ(theta), num_qubits=cs.N, tenant=tenant,
+                    priority=prio, measure=measure))
+                if i % 3 == 2:
+                    for _ in range(2):
+                        server.step()
+            server.run_until_idle(max_steps=cs.STEP_BOUND)
+            base = f"http://{host}:{port}"
+            metrics = urllib.request.urlopen(
+                base + "/metrics").read().decode()
+            healthz = json.loads(urllib.request.urlopen(
+                base + "/healthz").read().decode())
+            traces = {h.id: server.tracez(h) for h in handles}
+            trace_path = os.path.join(demo_dir, "job_traces.json")
+            with open(trace_path, "w") as f:
+                json.dump(traces, f, sort_keys=True)
+            done = sum(1 for h in handles if h.state == "done")
+            complete = sum(1 for tz in traces.values()
+                           if tz and tz.get("complete"))
+            reasons = []
+            for path in server.flight_dumps:
+                with open(path) as f:
+                    reasons.append(json.load(f)["reason"])
+            dump_count = len(server.flight_dumps)
+        finally:
+            server.close()
+    finally:
+        if old_dir is None:
+            os.environ.pop("QT_SERVE_FLIGHT_DIR", None)
+        else:
+            os.environ["QT_SERVE_FLIGHT_DIR"] = old_dir
+    _set_compile(0.0)  # host-side scheduling demo; no fresh kernels
+    _emit(18, "observability: complete request traces under chaos",
+          float(complete), "traces_complete",
+          round(time.perf_counter() - t0, 3),
+          {"jobs_done": done,
+           "poisoned": sorted(poisoned),
+           "metrics_live": metrics == T.prometheus_text(),
+           "healthz_status": healthz["status"],
+           "flight_dumps": dump_count,
+           "flight_dump_reasons": reasons,
+           "demo_dir": demo_dir,
+           "job_traces": trace_path})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17}
+           15: config15, 16: config16, 17: config17, 18: config18}
 
 
 def main():

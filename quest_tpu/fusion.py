@@ -418,6 +418,29 @@ def _run(qureg, items) -> None:
         _gov.end_drain()
 
 
+def _group_route(gprog) -> str:
+    """Dominant plan-entry family of one dispatch group — the §30
+    wall-time attribution label.  Precedence reflects cost dominance: a
+    megawin anywhere makes the group megakernel-shaped; else fused
+    window passes; else permutation fast paths; else channel sweeps;
+    else pure remap exchange."""
+    saw = set()
+    for part in gprog:
+        if part[0] == "plan":
+            for sk in part[1]:
+                saw.add("megawin" if sk[0] == "megawin" else "winfused")
+        elif part[0] == "perm":
+            saw.add("permfast")
+        elif part[0] in ("chan", "chansweep"):
+            saw.add("channel")
+        elif part[0] == "remap":
+            saw.add("remap")
+    for route in ("megawin", "winfused", "permfast", "channel", "remap"):
+        if route in saw:
+            return route
+    return "other"
+
+
 def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
                   bsz, perm0, mats_batched, final_perm) -> None:
     """Telemetry accounting + dispatch of a planned drain, in (possibly
@@ -552,6 +575,17 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
     # collective guard so a dead peer surfaces as ShardLossError and
     # the resilience layer can fail over (docs/design.md §19)
     groups = (gov or {}).get("groups") or (program,)
+    # §30 per-op wall-time attribution: each dispatched group is timed
+    # and charged to its dominant plan-entry route (megawin / winfused /
+    # permfast / channel / remap) — plan_route_seconds{route} feeds the
+    # reportPerf attribution section and its dispatch-bound detector.
+    # Trace mode blocks on the group result so the sample is true wall
+    # time; the default mode times dispatch only (no added sync on the
+    # hot path — the <5% bench_telemetry budget).
+    import time as _time
+
+    attrib = _telemetry.enabled()
+    deep = attrib and _telemetry.mode_name() == "trace"
     ai = pi = 0
     for gprog in groups:
         a0, p0 = ai, pi
@@ -568,7 +602,15 @@ def _run_dispatch(qureg, items, program, arrays, gov, *, n, nsh, nloc,
         else:
             def dispatch(r=runner, ga=garrays, gp=gprobs):
                 return r(qureg._amps, ga, gp)
+        t0 = _time.perf_counter() if attrib else 0.0
         qureg._amps = _gov.oom_net(dispatch, qureg)
+        if attrib:
+            if deep:
+                jax.block_until_ready(qureg._amps)
+            route = _group_route(gprog)
+            _telemetry.observe("plan_route_seconds",
+                               _time.perf_counter() - t0, route=route)
+            _telemetry.inc("plan_route_dispatch_total", route=route)
     if nsh:
         if final_perm is not None and list(final_perm) != list(range(n)):
             qureg._perm = tuple(final_perm)

@@ -885,11 +885,41 @@ def reconcile_drain(*, bit_sets: Sequence, n: int, nloc: int, nsh: int,
     if drift:
         for kind in drift:
             _telemetry.inc("model_drift_total", kind=kind)
+        _telemetry.flight_event("model_drift",
+                                kinds=",".join(sorted(drift)),
+                                shards=1 << nsh, items=len(bit_sets))
         _LOG.warning(json.dumps(
             {"event": "model_drift", "kinds": sorted(drift),
              "drift": drift, "shards": 1 << nsh, "items": len(bit_sets)},
             sort_keys=True))
     return drift
+
+
+def measure_dispatch_floor(calls: int = 64) -> float:
+    """Median host cost of dispatching ONE trivial jitted program — the
+    live, in-process version of scripts/bench_dispatch.py's per-program
+    overhead probe.  Publishes the ``per_program_dispatch_seconds``
+    gauge; the §30 per-op attribution section of ``reportPerf`` labels a
+    route ``dispatch_bound`` when its mean dispatched-group wall time
+    sits within 10% of this floor (the r04->r05 measurement regime,
+    flagged live instead of by forensic bisection)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros(())
+    f(x).block_until_ready()  # compile outside the timed loop
+    samples = []
+    for _ in range(max(8, int(calls))):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    floor = samples[len(samples) // 2]
+    _telemetry.set_gauge("per_program_dispatch_seconds", floor)
+    return floor
 
 
 # camelCase mirrors (the reference-style API surface)

@@ -88,6 +88,7 @@ def record_degradation(name: str, reason: str) -> None:
         return
     DEGRADATIONS[name] = reason
     _telemetry.inc("degradations_total", name=name)
+    _telemetry.flight_event("degradation", name=name, reason=reason)
     warnings.warn(f"quest_tpu degraded: {name}: {reason}", stacklevel=2)
 
 
@@ -1120,6 +1121,11 @@ def _watchdog_step(qureg, ckpt_dir: str, policy: str,
                    log_ctx: Optional[Tuple[str, float]] = None) -> None:
     def _verdict(v: str) -> None:
         _telemetry.inc("watchdog_verdicts_total", policy=policy, verdict=v)
+        if v != "ok":
+            # the flight ring records the interesting verdicts; routine
+            # "ok" checks would wash real incidents out of a bounded ring
+            _telemetry.flight_event("watchdog", policy=policy, verdict=v,
+                                    window=f"{window[0]}..{window[1]}")
         if log_ctx is not None:
             run_id, t_run = log_ctx
             _log_event(run_id, "watchdog", window=list(window), verdict=v,

@@ -82,6 +82,19 @@ the mesh-portable restore (serving is the first consumer of checkpoint
 REGROW).  The seeded chaos harness ``scripts/chaos_serve.py``
 (``make verify-chaos``) drives all of it end-to-end.
 
+**Observability** (docs/design.md §30).  Every submitted job carries a
+``trace_id``; the server threads it through the whole lifecycle
+(admit -> bank_join -> window -> preempt/resume/retry -> complete or
+failed) as request-scoped span trees queryable via :meth:`SimServer.tracez`
+and ``telemetry.tracez``.  Incidents — quarantine verdicts, elastic
+degradation, OOM/poison bisection, terminal executor failure — dump the
+telemetry flight recorder (the bounded ring of recent structured
+events) to JSON under ``QT_SERVE_FLIGHT_DIR`` automatically.
+:meth:`SimServer.serve_http` starts a stdlib HTTP thread exposing
+``/metrics`` (the Prometheus exposition, byte-identical to
+``telemetry.prometheus_text()``), ``/healthz`` (degraded / queue-depth
+/ quarantine state), and ``/tracez`` (+ ``/tracez/<trace_id>``).
+
 Environment knobs (all optional, constructor args win):
 
 - ``QT_SERVE_WINDOW``       gates per fusion window        (default 16)
@@ -93,14 +106,19 @@ Environment knobs (all optional, constructor args win):
 - ``QT_SERVE_QUARANTINE``   breaker ``count:open_seconds`` (default 2:30)
 - ``QT_SERVE_WATCHDOG``     health-check cadence, windows  (default 8; 0=only
   at bank completion — completion is always checked)
+- ``QT_SERVE_FLIGHT_DIR``   incident flight-record dump dir (default:
+  ``<ckpt root>/flight``)
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
+import json
 import os
 import shutil
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -149,6 +167,12 @@ _CKPT_DIR_ENV = "QT_SERVE_CKPT_DIR"
 _RETRIES_ENV = "QT_SERVE_RETRIES"
 _QUARANTINE_ENV = "QT_SERVE_QUARANTINE"
 _WATCHDOG_ENV = "QT_SERVE_WATCHDOG"
+_FLIGHT_DIR_ENV = "QT_SERVE_FLIGHT_DIR"
+
+# server serial numbers keep trace ids ("s<serial>-j<jid>") globally
+# unique across SimServer instances sharing one telemetry registry (the
+# chaos harness runs baseline and chaos servers in one process)
+_SERVER_SEQ = itertools.count()
 
 # bank-dissolve reasons (the serve_bank_retries_total label values)
 _RETRY_REASONS = ("transient", "failover", "poison")
@@ -284,7 +308,7 @@ class Job:
                  "seed", "measure", "state", "amps", "outcomes",
                  "key_state", "error", "errors", "bytes", "t_submit",
                  "t_start", "t_done", "attempts", "not_before",
-                 "backoff", "bisect_group")
+                 "backoff", "bisect_group", "trace_id")
 
     def __init__(self, jid: int, tenant: str, gates: list,
                  num_qubits: int, priority: str, seed, measure: tuple,
@@ -312,6 +336,8 @@ class Job:
         # quarantine bisection: (group-tag, bank-size cap) or None —
         # jobs only share a bank with the same group
         self.bisect_group: Optional[Tuple[str, int]] = None
+        # request-scoped trace id ("s<serial>-j<jid>", set at admit)
+        self.trace_id = ""
 
     @property
     def done(self) -> bool:
@@ -487,6 +513,12 @@ class SimServer:
         # before the ShardLossError even unwinds to _advance
         self._mesh_cb = lambda _event, _info: _governor.refresh_budget()
         _ptopo.add_mesh_listener(self._mesh_cb)
+        self._serial = next(_SERVER_SEQ)
+        self._flight_dir = os.environ.get(_FLIGHT_DIR_ENV, "").strip() \
+            or os.path.join(self._ckpt_root, "flight")
+        self.flight_dumps: List[str] = []
+        self._http = None
+        self._http_thread: Optional[threading.Thread] = None
         _telemetry.set_gauge("serve_degraded", 0.0)
 
     # -- tenants ---------------------------------------------------------
@@ -580,6 +612,12 @@ class SimServer:
         t.inflight_bytes += nbytes
         t.submitted += 1
         self._queued += 1
+        job.trace_id = f"s{self._serial}-j{jid}"
+        _telemetry.trace_begin(job.trace_id, "job", tenant=t.name,
+                               priority=priority,
+                               qubits=int(num_qubits))
+        _telemetry.trace_point(job.trace_id, "serve.admit",
+                               queue_depth=self._queued)
         _telemetry.inc("serve_jobs_submitted_total", tenant=t.name)
         _telemetry.set_gauge("serve_queue_depth", self._queued)
         return job
@@ -587,6 +625,8 @@ class SimServer:
     def _reject(self, t: Tenant, kind: str, limit, value) -> None:
         _telemetry.inc("serve_jobs_rejected_total", tenant=t.name,
                        kind=kind)
+        _telemetry.flight_event("admission_rejected", tenant=t.name,
+                                reason=kind, limit=limit, value=value)
         raise QuotaExceededError(
             f"SimServer.submit: tenant {t.name!r} over {kind} limit "
             f"({value} > {limit}) — back off and retry",
@@ -667,9 +707,13 @@ class SimServer:
             self._queued -= 1
             _telemetry.observe("serve_queue_wait_seconds",
                                now - j.t_submit, tenant=j.tenant)
+            _telemetry.trace_point(j.trace_id, "serve.bank_join",
+                                   bank=bank.seq, attempt=j.attempts,
+                                   batch=bank.B)
         _telemetry.inc("serve_banks_total")
         _telemetry.set_gauge("serve_queue_depth", self._queued)
         self._publish_occupancy(bank)
+        self._refresh_watermark()
 
     def _publish_occupancy(self, bank: _Bank) -> None:
         occ = _batch.bank_occupancy(bank.qureg, real=len(bank.jobs))
@@ -694,6 +738,11 @@ class SimServer:
         if self.preempt == "off" or not bank.running or bank.paused:
             return
         _telemetry.inc("preemptions_total", mode=self.preempt)
+        if _telemetry.enabled():
+            for j in bank.jobs:
+                _telemetry.trace_point(j.trace_id, "serve.preempt",
+                                       bank=bank.seq,
+                                       mode=self.preempt)
         if self.preempt == "pause":
             bank.paused = True
             return
@@ -727,6 +776,10 @@ class SimServer:
             fingerprint=bank.sfp)
         bank.preempted = False
         _telemetry.inc("serve_resumes_total")
+        if _telemetry.enabled():
+            for j in bank.jobs:
+                _telemetry.trace_point(j.trace_id, "serve.resume",
+                                       bank=bank.seq, cursor=cursor)
 
     # -- scheduling ------------------------------------------------------
 
@@ -827,9 +880,17 @@ class SimServer:
             elif bank.preempted:
                 self._resume(bank)
             bank.paused = False
+            w = bank.ex.window
+            t0 = time.perf_counter()
             with _telemetry.span("serve.window", bank=bank.seq,
-                                 window=bank.ex.window):
+                                 window=w):
                 bank.ex.step()
+            if _telemetry.enabled():
+                dur = time.perf_counter() - t0
+                for j in bank.jobs:
+                    _telemetry.trace_add(j.trace_id, "serve.window",
+                                         t0=t0, dur=dur,
+                                         bank=bank.seq, window=w)
             _telemetry.inc("serve_windows_total")
             self._charge(bank)
             self._maybe_poison(bank)
@@ -941,6 +1002,11 @@ class SimServer:
         t = self.tenants[job.tenant]
         t.inflight -= 1
         t.inflight_bytes -= job.bytes
+        _telemetry.trace_point(job.trace_id, "serve.failed",
+                               error=type(err).__name__,
+                               attempts=max(1, job.attempts),
+                               quarantined=quarantined)
+        _telemetry.trace_end(job.trace_id, status="failed")
         _telemetry.inc("serve_jobs_failed_total", tenant=job.tenant)
         if quarantined:
             _telemetry.inc("serve_jobs_quarantined_total",
@@ -961,6 +1027,9 @@ class SimServer:
         to FAILED with the full per-attempt error chain."""
         jobs = requeue if requeue is not None else list(bank.jobs)
         _telemetry.inc("serve_bank_retries_total", reason=reason)
+        _telemetry.flight_event("bank_dissolved", bank=bank.seq,
+                                reason=reason, jobs=len(jobs),
+                                error=f"{type(err).__name__}: {err}")
         now = time.monotonic()
         for job in jobs:
             started = job.t_start is not None
@@ -980,6 +1049,10 @@ class SimServer:
             if started:
                 self._queued += 1
             self._buckets.setdefault(bank.key, []).append(job)
+            _telemetry.trace_point(
+                job.trace_id, "serve.retry", reason=reason,
+                attempt=job.attempts,
+                backoff=round(job.backoff or 0.0, 4))
         self._drop_bank(bank)
         _telemetry.set_gauge("serve_queue_depth", self._queued)
 
@@ -993,8 +1066,14 @@ class SimServer:
             br = self._breakers[key] = _Breaker(self._q_threshold,
                                                 self._q_open_seconds)
         br.record_failure()
+        _telemetry.trace_point(job.trace_id, "serve.quarantine",
+                               breaker=br.state,
+                               failures=br.failures)
         self._fail_job(job, err, quarantined=True)
         _telemetry.set_gauge("serve_queue_depth", self._queued)
+        self._flight_dump("quarantine", tenant=job.tenant, job=job.id,
+                          trace_id=job.trace_id, breaker=br.state,
+                          error=f"{type(err).__name__}: {err}")
 
     def _quarantine_or_bisect(self, bank: _Bank,
                               err: BaseException) -> None:
@@ -1006,6 +1085,13 @@ class SimServer:
         (log2(B) rounds) — with bank-mates requeued free of charge, so
         innocents always complete."""
         jobs = list(bank.jobs)
+        _telemetry.flight_event(
+            "bisect", bank=bank.seq, jobs=len(jobs),
+            attributed=getattr(err, "element", None) is not None,
+            error=f"{type(err).__name__}: {err}")
+        if _governor._is_oom(err):
+            self._flight_dump("oom_bisect", bank=bank.seq,
+                              jobs=len(jobs))
         if len(jobs) == 1:
             self._quarantine(jobs[0], bank, err)
             self._dissolve(bank, err, reason="poison", charge=False,
@@ -1091,6 +1177,9 @@ class SimServer:
         _telemetry.set_gauge("serve_degraded", 1.0)
         _telemetry.set_gauge("serve_failover_mttr_seconds",
                              time.perf_counter() - t0)
+        self._flight_dump("failover", from_devices=old_n,
+                          to_devices=new_n, dead_host=dead_host,
+                          error=f"{type(err).__name__}: {err}")
 
     def heal(self) -> bool:
         """Re-expand onto the recovered full mesh — the operator signal
@@ -1168,11 +1257,16 @@ class SimServer:
             br = self._breakers.get((job.tenant, bank.key))
             if br is not None:
                 br.record_success()
+            _telemetry.trace_point(job.trace_id, "serve.complete",
+                                   outcomes=len(job.outcomes),
+                                   attempts=job.attempts)
+            _telemetry.trace_end(job.trace_id, status="done")
             _telemetry.inc("serve_jobs_completed_total",
                            tenant=job.tenant)
             _telemetry.observe("serve_job_seconds", now - job.t_submit,
                                tenant=job.tenant)
         self._publish_occupancy(bank)
+        self._refresh_watermark()
         self._banks.remove(bank)
         _governor.release(q)
         bank.qureg = None
@@ -1184,10 +1278,164 @@ class SimServer:
         """Terminal bank failure (memory refusal with nothing left to
         evict): every member exhausts to FAILED — each wrapped per-job
         by Job.result's JobFailedError, never a shared raise."""
+        _telemetry.flight_event("executor_failure", bank=bank.seq,
+                                jobs=len(bank.jobs),
+                                error=f"{type(err).__name__}: {err}")
         for job in bank.jobs:
             self._fail_job(job, err)
         self._drop_bank(bank)
         _telemetry.set_gauge("serve_queue_depth", self._queued)
+        self._flight_dump("executor_failure", bank=bank.seq,
+                          error=f"{type(err).__name__}: {err}")
+
+    # -- observability front door ----------------------------------------
+
+    def _refresh_watermark(self) -> None:
+        """Refresh the ``device_memory_watermark_bytes{device}`` gauges
+        at a bank boundary (start/finalize) so HBM pressure in /metrics
+        tracks the resident set, not just drains."""
+        if not _telemetry.enabled():
+            return
+        from .utils import profiling as _prof
+
+        _prof.memory_watermark()
+
+    def _flight_dump(self, reason: str, **context):
+        """Dump the telemetry flight recorder for one serve incident.
+        Best-effort: a dump failure is counted
+        (``flight_dump_errors_total``), never raised — the incident
+        handler this rides on must still run.  Returns the written path
+        (also appended to :attr:`flight_dumps`) or None."""
+        if not _telemetry.enabled():
+            return None
+        path = os.path.join(
+            self._flight_dir,
+            f"flight_s{self._serial}_{len(self.flight_dumps)}"
+            f"_{reason}.json")
+        try:
+            out = _telemetry.dump_flight(path, reason=reason,
+                                         server=self._serial, **context)
+        except OSError:
+            _telemetry.inc("flight_dump_errors_total", reason=reason)
+            return None
+        if out:
+            self.flight_dumps.append(out)
+        return out
+
+    def tracez(self, job=None) -> Optional[dict]:
+        """The reconstructed span tree of one job — the server's view
+        over ``telemetry.tracez``.  ``job`` may be a :class:`Job`
+        handle, a job id (mapped through this server's trace-id
+        namespace), or a raw trace-id string; None returns the index of
+        every held trace.  Unknown ids return None."""
+        if job is None:
+            return _telemetry.tracez(None)
+        if isinstance(job, Job):
+            tid = job.trace_id
+        elif isinstance(job, int):
+            tid = f"s{self._serial}-j{job}"
+        else:
+            tid = str(job)
+        return _telemetry.tracez(tid)
+
+    def _healthz(self) -> dict:
+        """Health snapshot behind ``/healthz``.  stats() iterates live
+        dicts the scheduling thread mutates; a concurrent resize raises
+        RuntimeError, so the HTTP thread retries the snapshot instead
+        of locking the scheduling hot path."""
+        for _ in range(8):
+            try:
+                s = self.stats()
+                break
+            except RuntimeError:
+                continue
+        else:
+            s = {"queued": self._queued, "completed": self.completed}
+        degraded = bool(s.get("degraded"))
+        breakers = int(s.get("open_breakers", 0))
+        return {
+            "status": "degraded" if degraded or breakers else "ok",
+            "degraded": degraded,
+            "devices": int(s.get("devices", self.env.num_devices)),
+            "queue_depth": int(s.get("queued", 0)),
+            "waiting_unbanked": int(s.get("waiting_unbanked", 0)),
+            "banks": int(s.get("banks", 0)),
+            "preempted_banks": int(s.get("preempted_banks", 0)),
+            "completed": int(s.get("completed", 0)),
+            "open_breakers": breakers,
+            "flight_dumps": len(self.flight_dumps),
+        }
+
+    def serve_http(self, host: str = "127.0.0.1",
+                   port: int = 0) -> Tuple[str, int]:
+        """Start the live observability endpoint on a daemon thread and
+        return its bound ``(host, port)`` (``port=0`` picks a free
+        one).  Endpoints:
+
+        - ``GET /metrics``  — the Prometheus exposition, byte-identical
+          to ``telemetry.prometheus_text()``;
+        - ``GET /healthz``  — JSON health: degraded flag, queue depth,
+          open quarantine breakers (non-"ok" status when either);
+        - ``GET /tracez``   — JSON index of held request traces;
+          ``/tracez/<trace_id>`` (or ``?id=``) one reconstructed span
+          tree (404 for unknown ids).
+
+        Idempotent: a second call returns the existing address.  The
+        thread dies with :meth:`close` (or the process — daemon)."""
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        if self._http is not None:
+            return self._http.server_address
+        server = self
+
+        class _ObsHandler(BaseHTTPRequestHandler):
+            def log_message(self, *_args):  # no stderr chatter
+                pass
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, code: int, doc: dict) -> None:
+                self._send(code, json.dumps(doc, sort_keys=True),
+                           "application/json")
+
+            def do_GET(self) -> None:
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
+                    self._send(
+                        200, _telemetry.prometheus_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    self._json(200, server._healthz())
+                elif path == "/tracez" or path.startswith("/tracez/"):
+                    tid = path[len("/tracez/"):]
+                    if not tid and query.startswith("id="):
+                        tid = query[len("id="):]
+                    doc = server.tracez(tid or None)
+                    if doc is None:
+                        self._json(404, {"error":
+                                         f"unknown trace id {tid!r}"})
+                    else:
+                        self._json(200, doc)
+                else:
+                    self._json(404, {
+                        "error": f"no route {path!r}",
+                        "endpoints": ["/metrics", "/healthz",
+                                      "/tracez"]})
+
+        self._http = ThreadingHTTPServer((host, int(port)), _ObsHandler)
+        self._http.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, name="qt-serve-obs",
+            daemon=True)
+        self._http_thread.start()
+        return self._http.server_address
 
     # -- drivers ---------------------------------------------------------
 
@@ -1233,6 +1481,11 @@ class SimServer:
         if self._closed:
             return
         self._closed = True
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+            self._http_thread = None
         _ptopo.remove_mesh_listener(self._mesh_cb)
         for bank in self._banks:
             if bank.qureg is not None:

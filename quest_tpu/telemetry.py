@@ -35,14 +35,32 @@ process-wide registry every subsystem reports into:
   ``report_perf(env)`` / ``reportPerf`` mirroring the reference's
   ``report*`` print family.
 
+* **Request-scoped traces** — ``trace_begin``/``trace_point``/
+  ``trace_end`` record a per-``trace_id`` span tree (the serve layer
+  threads a job id through its whole lifecycle: admit -> bank ->
+  window* -> retry/preempt -> complete), queryable via :func:`tracez`
+  and served live at the SimServer ``/tracez`` endpoint.  Active in
+  BOTH enabled modes — the span tree is lifecycle observability, not
+  deep profiling — and bounded (id + per-id event caps, oldest id
+  evicted).
+
+* **Flight recorder** — :func:`flight_event` appends structured events
+  (spans, degradations, watchdog verdicts, drift, admission decisions)
+  to a bounded ring; :func:`dump_flight` writes the ring as a JSON
+  post-mortem artifact.  serve/resilience dump it automatically on
+  quarantine, elastic degradation, OOM bisection, and unhandled
+  executor failure, so every chaos incident leaves an artifact.
+
 Gating: ``QT_TELEMETRY=off|on|trace`` (default **on** — the whole point
 is always-on accounting).  Every recording entry point starts with one
 module-global int test, so the disabled path is a no-op check with
 measured-negligible overhead on the dispatch hot loop
-(scripts/bench_telemetry.py guards the enabled path at <5% on a 1k-gate
-fusion drain).  Counter updates are plain dict read-modify-writes —
-exact under the GIL for the single-threaded dispatch loop; concurrent
-writers may lose increments (telemetry is accounting, not a ledger).
+(scripts/bench_telemetry.py guards BOTH enabled modes — on AND trace —
+at <5% on a 1k-gate fusion drain).  Registry upserts take one shared
+``threading.Lock`` — serve runs asyncio plus HTTP/executor threads, so
+counter increments must be exact across writers, not merely
+GIL-approximate; the lock is acquired only on the enabled path, after
+the mode test.
 
 Dispatch-time semantics: the distributed wrappers record at *dispatch*
 (outside jit).  A quest_tpu call traced inside a user's own ``jax.jit``
@@ -54,6 +72,7 @@ from __future__ import annotations
 
 import atexit
 import bisect
+import collections
 import contextlib
 import json
 import math
@@ -68,13 +87,46 @@ _MODE_NAMES = {OFF: "off", ON: "on", TRACE: "trace"}
 
 _ENV_VAR = "QT_TELEMETRY"
 _TRACE_DIR_ENV = "QT_TELEMETRY_TRACE_DIR"
+_TRACE_MAX_ENV = "QT_TELEMETRY_TRACE_MAX"
+_FLIGHT_MAX_ENV = "QT_FLIGHT_EVENTS"
+_FLIGHT_DIR_ENV = "QT_FLIGHT_DIR"
+_TRACEZ_IDS_ENV = "QT_TRACEZ_JOBS"
+_TRACEZ_EVENTS_ENV = "QT_TRACEZ_EVENTS"
 
-# registry state: key = (metric name, canonical label tuple)
+
+def _env_cap(var: str, default: int) -> int:
+    raw = os.environ.get(var, "").strip()
+    return max(1, int(raw)) if raw else default
+
+
+# registry state: key = (metric name, canonical label tuple).  One lock
+# guards every upsert: the serve layer writes from asyncio + HTTP +
+# executor threads, and counters must be exact across them.  The lock is
+# taken only on the enabled path (after the _mode test), so the off path
+# stays a single int check.
+_LOCK = threading.Lock()
 _COUNTERS: dict = {}
 _GAUGES: dict = {}
 _HISTS: dict = {}
-_TRACE_EVENTS: list = []
+# Chrome-trace span buffer: a BOUNDED ring (a long trace-mode serve
+# session must not grow without bound) — overflow drops the OLDEST
+# event, counts trace_events_dropped_total, and write_trace notes the
+# drop in the emitted JSON.
+_TRACE_MAX = _env_cap(_TRACE_MAX_ENV, 65536)
+_TRACE_EVENTS: collections.deque = collections.deque()
+_TRACE_DROPPED = [0]  # drops since the last write_trace
 _TRACE_T0 = time.perf_counter()
+# flight recorder: bounded ring of recent structured events (spans,
+# degradations, watchdog verdicts, drift, admission decisions) dumped
+# as a JSON post-mortem on serve/resilience incidents
+_FLIGHT_MAX = _env_cap(_FLIGHT_MAX_ENV, 512)
+_FLIGHT: collections.deque = collections.deque(maxlen=_FLIGHT_MAX)
+_FLIGHT_SEQ = [0]
+# request-scoped trace store: trace_id -> {"events", "stack", "dropped"}
+# (bounded: oldest id evicted past _TRACEZ_IDS, per-id events capped)
+_TRACEZ_IDS = _env_cap(_TRACEZ_IDS_ENV, 256)
+_TRACEZ_EVENTS = _env_cap(_TRACEZ_EVENTS_ENV, 512)
+_JOB_TRACES: dict = {}
 
 
 def _resolve_mode() -> int:
@@ -112,12 +164,17 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Clear every recorded series and buffered trace event (tests and
-    benchmark harnesses; the mode is left unchanged)."""
-    _COUNTERS.clear()
-    _GAUGES.clear()
-    _HISTS.clear()
-    _TRACE_EVENTS.clear()
+    """Clear every recorded series, buffered trace event, flight-ring
+    entry, and request trace (tests and benchmark harnesses; the mode is
+    left unchanged)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
+        _TRACE_EVENTS.clear()
+        _TRACE_DROPPED[0] = 0
+        _FLIGHT.clear()
+        _JOB_TRACES.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -133,11 +190,13 @@ def _label_key(labels: dict) -> tuple:
 
 
 def inc(name: str, value: float = 1, /, **labels) -> None:
-    """Add ``value`` to the counter series ``name{labels}``."""
+    """Add ``value`` to the counter series ``name{labels}`` (exact under
+    concurrent writers — the upsert holds the registry lock)."""
     if not _mode:
         return
     key = (name, _label_key(labels))
-    _COUNTERS[key] = _COUNTERS.get(key, 0) + value
+    with _LOCK:
+        _COUNTERS[key] = _COUNTERS.get(key, 0) + value
 
 
 def counter_key(name: str, /, **labels) -> tuple:
@@ -152,14 +211,16 @@ def inc_key(key: tuple, value: float = 1) -> None:
     path)."""
     if not _mode:
         return
-    _COUNTERS[key] = _COUNTERS.get(key, 0) + value
+    with _LOCK:
+        _COUNTERS[key] = _COUNTERS.get(key, 0) + value
 
 
 def set_gauge(name: str, value: float, /, **labels) -> None:
     """Set the gauge series ``name{labels}`` to ``value``."""
     if not _mode:
         return
-    _GAUGES[(name, _label_key(labels))] = float(value)
+    with _LOCK:
+        _GAUGES[(name, _label_key(labels))] = float(value)
 
 
 # histogram bucket upper bounds, per metric name; the default suits
@@ -229,10 +290,11 @@ def observe(name: str, value: float, /, **labels) -> None:
     if not _mode:
         return
     key = (name, _label_key(labels))
-    h = _HISTS.get(key)
-    if h is None:
-        h = _HISTS[key] = _Hist(HIST_BOUNDS.get(name, _DEFAULT_BOUNDS))
-    h.add(float(value))
+    with _LOCK:
+        h = _HISTS.get(key)
+        if h is None:
+            h = _HISTS[key] = _Hist(HIST_BOUNDS.get(name, _DEFAULT_BOUNDS))
+        h.add(float(value))
 
 
 def record_exchange(op: str, count: int = 1, nbytes: int = 0, *,
@@ -261,6 +323,31 @@ def record_exchange(op: str, count: int = 1, nbytes: int = 0, *,
 # ---------------------------------------------------------------------------
 
 
+def _chrome_append(ev: dict) -> None:
+    """Append one Chrome-trace event to the BOUNDED ring: overflow drops
+    the oldest event and counts trace_events_dropped_total."""
+    with _LOCK:
+        if len(_TRACE_EVENTS) >= _TRACE_MAX:
+            _TRACE_EVENTS.popleft()
+            _TRACE_DROPPED[0] += 1
+            key = ("trace_events_dropped_total", ())
+            _COUNTERS[key] = _COUNTERS.get(key, 0) + 1
+        _TRACE_EVENTS.append(ev)
+
+
+def _chrome_event(name: str, t0: float, dt: float, attrs: dict) -> dict:
+    return {
+        "name": name,
+        "cat": "quest_tpu",
+        "ph": "X",
+        "ts": (t0 - _TRACE_T0) * 1e6,
+        "dur": dt * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "args": {k: str(v) for k, v in attrs.items()},
+    }
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs) -> Iterator[None]:
     """Host-side named region: observes ``span_seconds{name}``, appends a
@@ -281,16 +368,7 @@ def span(name: str, **attrs) -> Iterator[None]:
             dt = time.perf_counter() - t0
             observe("span_seconds", dt, name=name)
             if _mode == TRACE:
-                _TRACE_EVENTS.append({
-                    "name": name,
-                    "cat": "quest_tpu",
-                    "ph": "X",
-                    "ts": (t0 - _TRACE_T0) * 1e6,
-                    "dur": dt * 1e6,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident(),
-                    "args": {k: str(v) for k, v in attrs.items()},
-                })
+                _chrome_append(_chrome_event(name, t0, dt, attrs))
 
 
 def write_trace(path: Optional[str] = None) -> Optional[str]:
@@ -299,16 +377,24 @@ def write_trace(path: Optional[str] = None) -> Optional[str]:
     path, or None (writing nothing) when no events are buffered — so
     ``QT_TELEMETRY=off`` never creates trace files.  Default path:
     ``$QT_TELEMETRY_TRACE_DIR/qt_trace_<pid>.json`` (cwd when the env
-    var is unset)."""
+    var is unset).  When the bounded ring overflowed since the last
+    write, the emitted JSON notes the drop count under
+    ``otherData.trace_events_dropped``."""
     if not _TRACE_EVENTS:
         return None
     if path is None:
         d = os.environ.get(_TRACE_DIR_ENV, ".")
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"qt_trace_{os.getpid()}.json")
-    events, _TRACE_EVENTS[:] = list(_TRACE_EVENTS), []
+    with _LOCK:
+        events = list(_TRACE_EVENTS)
+        _TRACE_EVENTS.clear()
+        dropped, _TRACE_DROPPED[0] = _TRACE_DROPPED[0], 0
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped:
+        doc["otherData"] = {"trace_events_dropped": dropped}
     with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        json.dump(doc, f)
     return path
 
 
@@ -319,6 +405,248 @@ def _flush_trace_at_exit() -> None:  # pragma: no cover - process teardown
             write_trace()
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+def _jsonable(v):
+    return v if isinstance(v, (int, float, str, bool)) or v is None \
+        else str(v)
+
+
+def flight_event(kind: str, /, **fields) -> None:
+    """Append one structured event to the bounded flight ring — the
+    post-mortem record :func:`dump_flight` writes on incidents.  Feeds:
+    serve lifecycle/admission events, degradations, watchdog verdicts,
+    model drift, and mirrored request-trace spans.  Non-primitive field
+    values are stringified so the ring is always JSON-serializable.
+    ``kind`` is positional-only; the reserved ``ts``/``kind`` keys win
+    over same-named fields."""
+    if not _mode:
+        return
+    ev = {"ts": round(time.perf_counter() - _TRACE_T0, 6), "kind": kind}
+    for k, v in fields.items():
+        if k not in ("ts", "kind"):
+            ev[k] = _jsonable(v)
+    with _LOCK:
+        _FLIGHT.append(ev)
+
+
+def flight_snapshot() -> list:
+    """The flight ring's current contents, oldest first (a copy)."""
+    with _LOCK:
+        return list(_FLIGHT)
+
+
+def dump_flight(path: Optional[str] = None, *, reason: str = "manual",
+                **context) -> Optional[str]:
+    """Write the flight ring as a JSON post-mortem artifact:
+    ``{"reason", "ts", "context", "events"}``.  The ring is NOT drained
+    — each dump is a self-contained snapshot, and a later incident still
+    sees the earlier context.  Returns the path, or None when telemetry
+    is off (incident hooks fire unconditionally; the off mode must stay
+    artifact-free).  Default path:
+    ``$QT_FLIGHT_DIR/qt_flight_<pid>_<seq>.json`` (cwd when unset)."""
+    if not _mode:
+        return None
+    if path is None:
+        d = os.environ.get(_FLIGHT_DIR_ENV, ".")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"qt_flight_{os.getpid()}_{_FLIGHT_SEQ[0]}.json")
+    else:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    _FLIGHT_SEQ[0] += 1
+    doc = {
+        "reason": reason,
+        "ts": time.time(),  # qlint: allow(nondeterminism): the dump's wall-clock stamp IS the recorded value — a post-mortem artifact label, never program state
+        "context": {k: _jsonable(v) for k, v in context.items()},
+        "events": flight_snapshot(),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+    inc("flight_dumps_total", reason=reason)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+def _trace_rec(tid: str) -> dict:
+    # caller holds _LOCK
+    rec = _JOB_TRACES.get(tid)
+    if rec is None:
+        while len(_JOB_TRACES) >= _TRACEZ_IDS:
+            _JOB_TRACES.pop(next(iter(_JOB_TRACES)))
+        rec = _JOB_TRACES[tid] = {"events": [], "stack": [], "dropped": 0}
+    return rec
+
+
+def _trace_emit(tid: str, ev: dict) -> None:
+    # caller holds _LOCK; per-id event cap drops the OLDEST event
+    rec = _trace_rec(tid)
+    if len(rec["events"]) >= _TRACEZ_EVENTS:
+        rec["events"].pop(0)
+        rec["dropped"] += 1
+    rec["events"].append(ev)
+
+
+def _us(t: float) -> float:
+    return round((t - _TRACE_T0) * 1e6, 1)
+
+
+def trace_begin(tid: str, name: str, **attrs) -> None:
+    """Open a span on the request trace ``tid`` (closed by
+    :func:`trace_end`; the serve layer opens one root ``"job"`` span per
+    submitted job).  Active in both enabled modes."""
+    if not _mode:
+        return
+    with _LOCK:
+        rec = _trace_rec(tid)
+        rec["stack"].append(
+            (name, time.perf_counter(),
+             {k: str(v) for k, v in attrs.items()}))
+
+
+def trace_end(tid: str, **attrs) -> None:
+    """Close the innermost open span of ``tid``, recording it as a
+    complete event spanning its whole open interval; ``attrs`` merge
+    into the span args (e.g. ``status="done"``).  No-op when nothing is
+    open."""
+    if not _mode:
+        return
+    now = time.perf_counter()
+    with _LOCK:
+        rec = _JOB_TRACES.get(tid)
+        if rec is None or not rec["stack"]:
+            return
+        name, t0, args = rec["stack"].pop()
+        args.update({k: str(v) for k, v in attrs.items()})
+        ev = {"name": name, "ph": "X", "ts": _us(t0),
+              "dur": round((now - t0) * 1e6, 1),
+              "depth": len(rec["stack"]), "args": args}
+        _trace_emit(tid, ev)
+        _FLIGHT.append({"ts": round(now - _TRACE_T0, 6), "kind": "span",
+                        "trace": tid, "name": name, **args})
+        if _mode == TRACE:
+            chrome = _chrome_event(name, t0, now - t0, args)
+            chrome["args"]["trace_id"] = tid
+            if len(_TRACE_EVENTS) >= _TRACE_MAX:
+                _TRACE_EVENTS.popleft()
+                _TRACE_DROPPED[0] += 1
+                key = ("trace_events_dropped_total", ())
+                _COUNTERS[key] = _COUNTERS.get(key, 0) + 1
+            _TRACE_EVENTS.append(chrome)
+
+
+def trace_point(tid: str, name: str, **attrs) -> None:
+    """Record one instantaneous lifecycle event on ``tid`` (admit,
+    bank_join, retry, quarantine, complete, ...), mirrored into the
+    flight ring."""
+    if not _mode:
+        return
+    now = time.perf_counter()
+    args = {k: str(v) for k, v in attrs.items()}
+    with _LOCK:
+        rec = _trace_rec(tid)
+        _trace_emit(tid, {"name": name, "ph": "i", "ts": _us(now),
+                          "depth": len(rec["stack"]), "args": args})
+        _FLIGHT.append({"ts": round(now - _TRACE_T0, 6), "kind": "event",
+                        "trace": tid, "name": name, **args})
+
+
+def trace_add(tid: str, name: str, *, t0: float, dur: float,
+              **attrs) -> None:
+    """Attach an externally-timed complete span (perf_counter start +
+    duration) to ``tid`` — e.g. one bank window's measured wall time
+    mirrored onto every member job's trace."""
+    if not _mode:
+        return
+    args = {k: str(v) for k, v in attrs.items()}
+    with _LOCK:
+        rec = _trace_rec(tid)
+        _trace_emit(tid, {"name": name, "ph": "X", "ts": _us(t0),
+                          "dur": round(dur * 1e6, 1),
+                          "depth": len(rec["stack"]), "args": args})
+    if _mode == TRACE:
+        chrome = _chrome_event(name, t0, dur, attrs)
+        chrome["args"]["trace_id"] = tid
+        _chrome_append(chrome)
+
+
+@contextlib.contextmanager
+def trace_span(tid: str, name: str, **attrs) -> Iterator[None]:
+    """Context-manager sugar over trace_begin/trace_end."""
+    trace_begin(tid, name, **attrs)
+    try:
+        yield
+    finally:
+        trace_end(tid)
+
+
+def _trace_tree(events: list) -> list:
+    """Nest a trace's events by (ts, depth) containment: a depth-d event
+    is a child of the most recent still-open depth<(d) span."""
+    roots: list = []
+    stack: list = []  # (depth, node)
+    order = sorted(events, key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    for ev in order:
+        node = {"name": ev["name"], "ph": ev["ph"], "ts": ev["ts"],
+                "args": ev.get("args", {}), "children": []}
+        if "dur" in ev:
+            node["dur"] = ev["dur"]
+        d = ev.get("depth", 0)
+        while stack and stack[-1][0] >= d:
+            stack.pop()
+        (stack[-1][1]["children"] if stack else roots).append(node)
+        if ev["ph"] == "X":
+            stack.append((d, node))
+    return roots
+
+
+def trace_ids() -> list:
+    """Currently-held request trace ids, oldest first."""
+    with _LOCK:
+        return list(_JOB_TRACES)
+
+
+def tracez(tid: Optional[str] = None):
+    """The request-trace query API (served at ``/tracez``).  With no
+    argument: an index ``{"traces": {tid: {events, open, complete}}}``.
+    With a ``tid``: that trace's full record — flat ``events`` (ts/dur
+    in microseconds relative to the process trace epoch), the nested
+    ``tree``, still-``open`` span names, and ``complete`` (True when
+    every span closed and at least one event was recorded).  Returns
+    None for an unknown id."""
+    with _LOCK:
+        if tid is None:
+            return {"traces": {
+                t: {"events": len(r["events"]),
+                    "open": [s[0] for s in r["stack"]],
+                    "complete": not r["stack"] and bool(r["events"])}
+                for t, r in _JOB_TRACES.items()}}
+        rec = _JOB_TRACES.get(tid)
+        if rec is None:
+            return None
+        events = [dict(e) for e in rec["events"]]
+        open_spans = [{"name": s[0], "ts": _us(s[1]), "args": dict(s[2])}
+                      for s in rec["stack"]]
+        dropped = rec["dropped"]
+    return {
+        "trace_id": tid,
+        "events": sorted(events, key=lambda e: e["ts"]),
+        "open": open_spans,
+        "complete": not open_spans and bool(events),
+        "dropped": dropped,
+        "tree": _trace_tree(events),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -592,6 +920,32 @@ def perf_report(env=None) -> str:
             lines.append(
                 f"  hbm_round_trips/plan_window={trips:.3g} "
                 f"(1.0 = one read + one write per fused window)")
+    # §30 per-op wall-time attribution: each dispatched drain group's
+    # wall time, keyed by its dominant plan-entry family (megawin /
+    # winfused / permfast / channel / remap).  When the measured
+    # per-dispatch mean sits within 10% of the host's measured
+    # per-program dispatch floor (introspect.measure_dispatch_floor /
+    # scripts/bench_dispatch.py), the route is labeled dispatch_bound —
+    # the r04->r05 regression regime, detected live instead of by
+    # forensic bisection.
+    routes = snap["histograms"].get("plan_route_seconds", {})
+    if routes:
+        floor = gauge_max("per_program_dispatch_seconds")
+        lines.append("per-op attribution (§30, wall time by plan-entry "
+                     "route):")
+        for labels, hd in sorted(routes.items()):
+            mean = hd["sum"] / hd["count"] if hd["count"] else 0.0
+            verdict = ""
+            if floor and hd["count"] and mean <= floor * 1.10:
+                verdict = "  [dispatch_bound: mean within 10% of the " \
+                          "host dispatch floor]"
+            lines.append(
+                f"  {labels}: dispatches={hd['count']} "
+                f"total={hd['sum']:.6g}s mean={mean:.6g}s{verdict}")
+        if floor:
+            lines.append(
+                f"  dispatch floor: {floor:.3g}s/program "
+                f"(introspect.measure_dispatch_floor)")
     pred_c = counter_sum("predicted_exchanges_total", op="window_remap")
     meas_c = counter_sum("exchanges_total", op="window_remap")
     pred_b = counter_sum("predicted_exchange_bytes_total", op="window_remap")
@@ -656,6 +1010,17 @@ def perf_report(env=None) -> str:
         mttr = gauge_max("serve_failover_mttr_seconds")
         if mttr is not None:
             lines.append(f"  failover_mttr_seconds={mttr:.4g}")
+    # §30 observability surfaces: flight-ring occupancy / dump history
+    # and the request-trace store (the /tracez population)
+    fl = len(_FLIGHT)
+    dumps = counter_total("flight_dumps_total")
+    if fl or dumps:
+        lines.append(
+            f"flight recorder: {fl} event(s) buffered, "
+            f"{int(dumps)} dump(s) written")
+    if _JOB_TRACES:
+        lines.append(
+            f"request traces: {len(_JOB_TRACES)} trace(s) held (tracez)")
     peak = gauge_max("hbm_watermark_bytes")
     if peak is not None:
         lines.append(f"memory: hbm_watermark_bytes peak={_num(peak)} "

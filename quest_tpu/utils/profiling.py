@@ -81,7 +81,11 @@ def memory_watermark() -> dict:
     (``device_bytes_in_use`` / ``device_peak_bytes_in_use{device}``, and
     the consolidated ``hbm_watermark_bytes{device}`` the fusion drain
     samples at window boundaries — peak surfaced in
-    getEnvironmentString and reportPerf).  When NO device exposes
+    getEnvironmentString and reportPerf).  Every watermark sample is
+    mirrored into ``device_memory_watermark_bytes{device}`` — the
+    Prometheus-facing series the serve layer refreshes at bank
+    boundaries so HBM pressure is live in ``/metrics`` (docs/design.md
+    §30).  When NO device exposes
     memory_stats (the CPU backend), the memory governor's modeled
     per-device peak stands in under ``device="model"`` when a budget is
     active (so the CPU dryrun's watermark agrees with the predictor —
@@ -108,6 +112,8 @@ def memory_watermark() -> dict:
             saw_device_stats = True
             _telemetry.set_gauge("hbm_watermark_bytes", peak,
                                  device=str(d))
+            _telemetry.set_gauge("device_memory_watermark_bytes", peak,
+                                 device=str(d))
     if not saw_device_stats:
         from .. import governor as _governor
 
@@ -116,9 +122,14 @@ def memory_watermark() -> dict:
             out["model"] = {"modeled_peak_bytes_in_use": int(modeled)}
             _telemetry.set_gauge("hbm_watermark_bytes", modeled,
                                  device="model")
+            _telemetry.set_gauge("device_memory_watermark_bytes", modeled,
+                                 device="model")
         else:
             try:
-                _telemetry.set_gauge("hbm_watermark_bytes", _maxrss_bytes(),
+                rss = _maxrss_bytes()
+                _telemetry.set_gauge("hbm_watermark_bytes", rss,
+                                     device="host")
+                _telemetry.set_gauge("device_memory_watermark_bytes", rss,
                                      device="host")
             # qlint: allow(broad-except): max-RSS is a best-effort POSIX probe; a non-POSIX host just skips the watermark sample
             except Exception:  # pragma: no cover - non-POSIX host

@@ -20,7 +20,15 @@ The acceptance invariants asserted per seed (docs/design.md §27):
     ones (every other tenant's every job completes);
 (c) the server reaches idle within a bounded step count (no deadlock or
     livelock) with empty queues and no resident banks;
-(d) availability over non-poison jobs is 100%.
+(d) availability over non-poison jobs is 100%;
+(e) observability (docs/design.md §30): every quarantine and failover
+    incident in the chaos arm produced a parseable flight-recorder
+    dump (valid JSON carrying the incident reason and the event ring);
+(f) every completed chaos job's request trace reconstructs via
+    ``SimServer.tracez`` as a COMPLETE well-nested span tree — admit,
+    bank_join, at least one executed window, then complete, in that
+    order — with the retry visible for every job the chaos killed and
+    re-ran.
 
 Usage: python scripts/chaos_serve.py [--seeds 11,12,37]
 Exits non-zero on any violated invariant; emits one JSON line per seed
@@ -123,6 +131,16 @@ def _schedule(seed):
     return ",".join(parts), {poison_jid}
 
 
+def _load_dumps(paths):
+    """Parse flight dumps BEFORE the server's close() removes its
+    checkpoint root (the default dump dir lives under it)."""
+    docs = []
+    for path in paths:
+        with open(path) as f:
+            docs.append(json.load(f))
+    return docs
+
+
 def _run(env, jobs_spec, plan_spec):
     """Replay one trace; returns {jid: record} plus the server stats."""
     plan = R.FaultPlan(plan_spec) if plan_spec else None
@@ -159,7 +177,9 @@ def _run(env, jobs_spec, plan_spec):
                 else (np.asarray(h.key_state["key"]).tobytes(),
                       int(h.key_state["counter"])),
             }
-        return out, stats, steps, plan
+        dumps = _load_dumps(server.flight_dumps)
+        traces = {h.id: server.tracez(h) for h in handles}
+        return out, stats, steps, plan, dumps, traces
     finally:
         server.close()
 
@@ -169,13 +189,14 @@ def run_seed(seed):
     R.seed_backoff_jitter([seed])
     env = qt.createQuESTEnv()
     qt.seedQuEST(env, [seed])
-    base, base_stats, base_steps, _ = _run(env, _trace(seed), "")
+    base, base_stats, base_steps, _, _, _ = _run(env, _trace(seed), "")
 
     R.seed_backoff_jitter([seed])
     env = qt.createQuESTEnv()
     qt.seedQuEST(env, [seed])
     plan_spec, poisoned = _schedule(seed)
-    chaos, stats, steps, plan = _run(env, _trace(seed), plan_spec)
+    chaos, stats, steps, plan, dumps, traces = _run(
+        env, _trace(seed), plan_spec)
 
     violations = []
     # (c) bounded idle: run_until_idle returned because nothing was
@@ -217,6 +238,44 @@ def run_seed(seed):
     for kind in ("bank_fault", "heal", "poison_job"):
         if kind not in fired:
             violations.append(f"armed {kind} never fired (log={plan.log})")
+    # (e) every quarantine/failover incident left a parseable flight
+    # dump (already json.load-ed by _run; structure checked here)
+    reasons = []
+    for doc in dumps:
+        if not (isinstance(doc, dict) and doc.get("reason")
+                and isinstance(doc.get("events"), list)):
+            violations.append(f"malformed flight dump: {doc!r:.120}")
+            continue
+        reasons.append(doc["reason"])
+    for expected in ("quarantine", "failover"):
+        if expected not in reasons:
+            violations.append(
+                f"no flight dump for the {expected} incident "
+                f"(got {reasons})")
+    # (f) every completed chaos job reconstructs as a complete,
+    # well-nested span tree with the lifecycle in causal order and the
+    # retry visible when chaos killed its bank
+    for j in completed:
+        tz = traces.get(j)
+        if tz is None or not tz.get("complete") or tz.get("open"):
+            violations.append(f"job {j}: trace incomplete ({tz!r:.120})")
+            continue
+        names = [e["name"] for e in tz["events"]]
+        order = [names.index(n) for n in
+                 ("serve.admit", "serve.bank_join", "serve.window",
+                  "serve.complete")
+                 if n in names]
+        if len(order) != 4 or order != sorted(order):
+            violations.append(f"job {j}: lifecycle out of order {names}")
+        roots = tz.get("tree") or []
+        if len(roots) != 1 or roots[0]["name"] != "job" \
+                or not roots[0].get("children"):
+            violations.append(
+                f"job {j}: span tree not rooted at one 'job' span")
+        if chaos[j]["attempts"] > 1 and "serve.retry" not in names:
+            violations.append(
+                f"job {j}: {chaos[j]['attempts']} attempts but no "
+                f"serve.retry in its trace")
 
     return {
         "seed": seed,
@@ -231,6 +290,10 @@ def run_seed(seed):
         "baseline_steps": base_steps,
         "devices_after": stats["devices"],
         "degraded_after": stats["degraded"],
+        "flight_dump_reasons": reasons,
+        "traces_complete": sum(
+            1 for j in completed
+            if traces.get(j) and traces[j].get("complete")),
     }
 
 

@@ -27,7 +27,10 @@ Covers the PR's contracts:
 
 import ast
 import asyncio
+import json
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -427,6 +430,102 @@ class TestFailoverHeal:
             after = S._job_bytes_per_device(N, srv.env, False)
             # half the devices -> each holds twice the bytes
             assert after == 2 * before
+        finally:
+            srv.close()
+
+
+class TestObservability:
+    """§30: request traces, the flight recorder, and the /metrics front
+    door, exercised through the real serve lifecycle (docs/design.md)."""
+
+    THETAS = (0.3, 0.45, 0.6)
+
+    def test_retried_job_trace_complete_and_well_nested(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8,
+                          faults=R.FaultPlan("bank_fault@1"))
+        try:
+            jobs = [srv.submit(_circ(t), num_qubits=N, seed=100 + i,
+                               measure=(0, 2))
+                    for i, t in enumerate(self.THETAS)]
+            srv.run_until_idle(max_steps=800)
+            for j in jobs:
+                assert j.state == S.DONE and j.attempts == 2
+                tz = srv.tracez(j)
+                assert tz["complete"] and not tz["open"]
+                names = [e["name"] for e in tz["events"]]
+                # the root "job" span opens first, the retry of the
+                # killed bank is VISIBLE, and the lifecycle markers are
+                # causally ordered admit -> retry -> complete
+                assert names[0] == "job"
+                assert names.count("serve.bank_join") == 2  # two banks
+                assert names.index("serve.admit") \
+                    < names.index("serve.retry") \
+                    < names.index("serve.complete")
+                assert "serve.window" in names
+                # well-nested: ONE root span, everything else inside it
+                roots = tz["tree"]
+                assert len(roots) == 1 and roots[0]["name"] == "job"
+                assert roots[0]["args"]["status"] == "done"
+                assert len(roots[0]["children"]) == len(names) - 1
+                # integer ids resolve to this server's traces too
+                assert srv.tracez(j.id) == tz
+        finally:
+            srv.close()
+
+    def test_quarantine_writes_parseable_flight_dump(self, env, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv(S._FLIGHT_DIR_ENV, str(tmp_path))
+        srv = S.SimServer(env, window=4, max_batch=8, watchdog=1,
+                          quarantine=(1, 3600.0))
+        try:
+            bad = srv.submit(_circ(0.4), num_qubits=N, tenant="eve")
+            srv.faults = R.FaultPlan(f"poison_job@{bad.id}")
+            srv.run_until_idle(max_steps=400)
+            assert bad.state == S.FAILED
+            assert srv.flight_dumps
+            docs = []
+            for p in srv.flight_dumps:
+                with open(p) as f:
+                    docs.append(json.load(f))
+        finally:
+            srv.close()
+        (doc,) = [d for d in docs if d["reason"] == "quarantine"]
+        assert doc["context"]["tenant"] == "eve"
+        assert doc["context"]["job"] == bad.id
+        assert doc["context"]["trace_id"] == bad.trace_id
+        # the ring captured the incident's lead-up: the bisect verdict
+        # and the quarantine lifecycle event itself
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "bisect" in kinds
+        assert any(e.get("name") == "serve.quarantine"
+                   for e in doc["events"])
+
+    def test_metrics_endpoint_byte_matches_exposition(self, env):
+        srv = S.SimServer(env, window=4, max_batch=8)
+        try:
+            host, port = srv.serve_http()
+            # idempotent: a second call returns the SAME address
+            assert srv.serve_http() == (host, port)
+            job = srv.submit(_circ(0.3), num_qubits=N, seed=100,
+                             measure=(0, 2))
+            srv.run_until_idle(max_steps=400)
+            base = f"http://{host}:{port}"
+            body = urllib.request.urlopen(base + "/metrics",
+                                          timeout=10).read()
+            assert body == T.prometheus_text().encode("utf-8")
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as r:
+                hz = json.load(r)
+            assert hz["status"] == "ok" and hz["queue_depth"] == 0
+            assert hz["completed"] == 1 and hz["devices"] >= 1
+            with urllib.request.urlopen(
+                    base + f"/tracez/{job.trace_id}", timeout=10) as r:
+                tz = json.load(r)
+            assert tz["complete"]
+            assert tz == srv.tracez(job)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/tracez/nope", timeout=10)
+            assert ei.value.code == 404
         finally:
             srv.close()
 

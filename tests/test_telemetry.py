@@ -15,9 +15,12 @@ Covers the acceptance contract:
     line per checkpoint/restore/watchdog event.
 """
 
+import collections
 import json
 import logging
 import re
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -663,3 +666,251 @@ class TestServingResilienceSeries:
         s = get_environment_string(env)
         assert "Serve=retries:4,quarantined:1,failovers:1," \
                "heals:1,degraded:1" in s
+
+
+# ---------------------------------------------------------------------------
+# Bounded Chrome-trace ring (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedTraceRing:
+    """The trace buffer is a bounded ring: overflow drops the OLDEST
+    event, counts ``trace_events_dropped_total``, and ``write_trace``
+    notes the drops (then resets the accounting for the next capture)."""
+
+    def test_overflow_drops_oldest_and_counts(self, monkeypatch):
+        T.configure("trace")
+        monkeypatch.setattr(T, "_TRACE_MAX", 4)
+        for i in range(7):
+            with T.span("ring", seq=i):
+                pass
+        assert len(T._TRACE_EVENTS) == 4
+        assert [e["args"]["seq"] for e in T._TRACE_EVENTS] == \
+            ["3", "4", "5", "6"]
+        assert T.counter_total("trace_events_dropped_total") == 3
+
+    def test_write_trace_notes_drops_then_resets(self, monkeypatch,
+                                                 tmp_path):
+        T.configure("trace")
+        monkeypatch.setattr(T, "_TRACE_MAX", 2)
+        for _ in range(5):
+            with T.span("w"):
+                pass
+        path = T.write_trace(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) == 2
+        assert doc["otherData"]["trace_events_dropped"] == 3
+        # the drain reset the drop accounting: a fresh capture that
+        # does not overflow writes no otherData note
+        with T.span("w"):
+            pass
+        with open(T.write_trace(str(tmp_path / "t2.json"))) as f:
+            assert "otherData" not in json.load(f)
+
+
+class TestThreadExactness:
+    """The registry lock makes concurrent upserts exact (§30): no lost
+    increments or observations under contended writers on the inc /
+    inc_key / observe / set_gauge hot paths."""
+
+    def test_concurrent_writers_exact_totals(self):
+        workers, per = 8, 400
+        fast = T.counter_key("contended_fast_total", lane="x")
+        barrier = threading.Barrier(workers)
+
+        def work(k):
+            barrier.wait()
+            for _ in range(per):
+                T.inc("contended_total", worker=k % 2)
+                T.inc_key(fast)
+                T.observe("contended_seconds", 1e-6)
+                T.set_gauge("contended_gauge", float(k))
+
+        threads = [threading.Thread(target=work, args=(k,))
+                   for k in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert T.counter_total("contended_total") == workers * per
+        assert T.counter_total("contended_fast_total") == workers * per
+        hd = T.snapshot()["histograms"]["contended_seconds"][""]
+        assert hd["count"] == workers * per
+        assert hd["sum"] == pytest.approx(workers * per * 1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_oldest_dropped(self, monkeypatch):
+        monkeypatch.setattr(T, "_FLIGHT", collections.deque(maxlen=3))
+        for i in range(6):
+            T.flight_event("tick", seq=i)
+        evs = T.flight_snapshot()
+        assert [e["seq"] for e in evs] == [3, 4, 5]
+        assert all(e["kind"] == "tick" for e in evs)
+
+    def test_dump_parseable_and_ring_not_drained(self, tmp_path):
+        T.flight_event("bank_dissolved", bank=1, reason="transient",
+                       jobs=3)
+        T.flight_event("admission_rejected", tenant="acme",
+                       reason="queue_full", limit=4)
+        path = T.dump_flight(str(tmp_path / "f.json"), reason="quarantine",
+                             tenant="acme", job=7, error=ValueError("boom"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "quarantine"
+        assert doc["context"]["tenant"] == "acme"
+        assert doc["context"]["job"] == 7  # primitives pass through
+        assert doc["context"]["error"] == "boom"  # non-primitives -> str
+        assert [e["kind"] for e in doc["events"]] == \
+            ["bank_dissolved", "admission_rejected"]
+        assert doc["events"][0]["jobs"] == 3
+        assert T.counter_value("flight_dumps_total",
+                               reason="quarantine") == 1
+        # the ring is NOT drained: a later incident still sees the
+        # earlier context in its own dump
+        p2 = T.dump_flight(str(tmp_path / "g.json"), reason="failover")
+        with open(p2) as f:
+            assert len(json.load(f)["events"]) == 2
+
+    def test_reserved_keys_and_stringification(self):
+        T.flight_event("k", ts=-1.0, kind="spoof", err=ValueError("x"),
+                       n=2)
+        ev = T.flight_snapshot()[-1]
+        assert ev["kind"] == "k" and ev["ts"] >= 0  # reserved keys win
+        assert ev["err"] == "x" and ev["n"] == 2
+        json.dumps(ev)  # always JSON-serializable
+
+    def test_off_mode_records_nothing_writes_nothing(self, tmp_path):
+        T.configure("off")
+        T.flight_event("tick")
+        target = tmp_path / "f.json"
+        assert T.dump_flight(str(target), reason="x") is None
+        assert not target.exists()
+        T.configure("on")
+        assert T.flight_snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTraces:
+    def _lifecycle(self, tid):
+        """The serve-layer shape: one root "job" span wrapping points
+        (admit/complete), a nested span, and an externally-timed span."""
+        T.trace_begin(tid, "job", tenant="acme")
+        T.trace_point(tid, "serve.admit", queue_depth=1)
+        with T.trace_span(tid, "serve.window", bank=0):
+            pass
+        T.trace_add(tid, "serve.window", t0=time.perf_counter(),
+                    dur=1e-3, bank=0, window=1)
+        T.trace_point(tid, "serve.complete", outcomes=2)
+        T.trace_end(tid, status="done")
+
+    def test_complete_trace_well_nested(self):
+        self._lifecycle("s0-j1")
+        tz = T.tracez("s0-j1")
+        assert tz["complete"] and not tz["open"] and tz["dropped"] == 0
+        assert [e["name"] for e in tz["events"]] == \
+            ["job", "serve.admit", "serve.window", "serve.window",
+             "serve.complete"]
+        roots = tz["tree"]
+        assert len(roots) == 1 and roots[0]["name"] == "job"
+        assert roots[0]["args"] == {"tenant": "acme", "status": "done"}
+        assert [c["name"] for c in roots[0]["children"]] == \
+            ["serve.admit", "serve.window", "serve.window",
+             "serve.complete"]
+
+    def test_index_unknown_id_and_open_spans(self):
+        self._lifecycle("a")
+        T.trace_begin("b", "job")
+        assert T.tracez("nope") is None
+        idx = T.tracez()["traces"]
+        assert idx["a"]["complete"] and idx["a"]["events"] == 5
+        assert idx["b"]["open"] == ["job"] and not idx["b"]["complete"]
+        assert T.trace_ids() == ["a", "b"]
+        assert T.tracez("b")["open"][0]["name"] == "job"
+
+    def test_id_eviction_oldest_first(self, monkeypatch):
+        monkeypatch.setattr(T, "_TRACEZ_IDS", 2)
+        for tid in ("t1", "t2", "t3"):
+            T.trace_point(tid, "x")
+        assert T.trace_ids() == ["t2", "t3"]
+        assert T.tracez("t1") is None
+
+    def test_per_id_event_cap_counts_drops(self, monkeypatch):
+        monkeypatch.setattr(T, "_TRACEZ_EVENTS", 3)
+        for i in range(5):
+            T.trace_point("t", "p", seq=i)
+        tz = T.tracez("t")
+        assert len(tz["events"]) == 3 and tz["dropped"] == 2
+        assert [e["args"]["seq"] for e in tz["events"]] == ["2", "3", "4"]
+
+    def test_mirrors_into_flight_ring(self):
+        self._lifecycle("s1-j2")
+        kinds = {(e["kind"], e.get("name")) for e in T.flight_snapshot()}
+        assert ("event", "serve.admit") in kinds
+        assert ("span", "job") in kinds
+
+    def test_off_mode_records_nothing(self):
+        T.configure("off")
+        T.trace_begin("t", "job")
+        T.trace_point("t", "x")
+        T.trace_end("t")
+        T.configure("on")
+        assert T.tracez("t") is None
+
+
+# ---------------------------------------------------------------------------
+# Per-op wall-time attribution (docs/design.md §30)
+# ---------------------------------------------------------------------------
+
+
+class TestPerOpAttribution:
+    def test_report_flags_dispatch_bound_route(self):
+        T.observe("plan_route_seconds", 0.0100, route="winfused")
+        T.observe("plan_route_seconds", 0.0102, route="winfused")
+        T.observe("plan_route_seconds", 0.5, route="megawin")
+        T.set_gauge("per_program_dispatch_seconds", 0.0095)
+        rep = T.perf_report()
+        assert "per-op attribution" in rep
+        lines = {l.split(":")[0].strip(): l for l in rep.splitlines()
+                 if "route=" in l}
+        assert "dispatch_bound" in lines["route=winfused"]
+        assert "dispatch_bound" not in lines["route=megawin"]
+
+    def test_no_floor_gauge_no_verdict(self):
+        T.observe("plan_route_seconds", 1e-4, route="winfused")
+        rep = T.perf_report()
+        assert "per-op attribution" in rep
+        assert "dispatch_bound" not in rep
+
+    def test_drain_records_route_series(self, env):
+        h = (1 / np.sqrt(2)) * np.array([[1.0, 1], [1, -1]],
+                                        dtype=complex)
+        q = qt.createQureg(4, env)
+        with qt.gateFusion(q):
+            for t in range(4):
+                qt.unitary(q, t, h)
+        qt.calcTotalProb(q)
+        routes = T.snapshot()["histograms"].get("plan_route_seconds", {})
+        assert routes, "drain recorded no per-route attribution"
+        assert T.counter_total("plan_route_dispatch_total") >= 1
+
+
+class TestMemoryWatermarkGauge:
+    def test_watermark_published_for_metrics(self, env):
+        from quest_tpu.utils import profiling
+        profiling.memory_watermark()
+        series = T.snapshot()["gauges"].get(
+            "device_memory_watermark_bytes", {})
+        assert series, "no watermark gauge published"
+        assert all(v >= 0 for v in series.values())
+        assert "device_memory_watermark_bytes" in T.prometheus_text()
