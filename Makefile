@@ -11,7 +11,7 @@ SHELL := /bin/bash
 NATIVE_DIR := quest_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/_qts.so
 
-.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-mega verify-obs verify-regress bench docs clean
+.PHONY: all native test verify verify-static verify-faults verify-telemetry verify-elastic verify-batch verify-introspect verify-governor verify-serve verify-pod verify-optimizer verify-chaos verify-sparse verify-mega verify-obs verify-coldstart verify-regress bench docs clean
 
 all: native
 
@@ -96,9 +96,19 @@ verify-obs:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py tests/test_serve_resilience.py -q -p no:cacheprovider -p no:xdist -p no:randomly
 	python scripts/bench_telemetry.py
 
+# Cold-start elimination (docs/design.md §31): the persistent AOT
+# executable cache + serve warm pools — the invalidation-matrix /
+# corruption / cross-process / warm-pool suite, then the fresh-process
+# gate: a cached child must deserialize instead of compiling (hits>=1,
+# puts==0), land within 2x steady state (+ deserialize allowance), and
+# reproduce the compiled run bit-identically.
+verify-coldstart:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_aotcache.py -q -p no:cacheprovider -p no:xdist -p no:randomly
+	env JAX_PLATFORMS=cpu python scripts/bench_coldstart.py --check
+
 # The tier-1 gate, verbatim from ROADMAP.md: CPU backend, not-slow
 # marker, collection errors surfaced, pass count echoed.
-verify: verify-static verify-serve verify-optimizer verify-chaos verify-sparse verify-mega
+verify: verify-static verify-serve verify-optimizer verify-chaos verify-sparse verify-mega verify-coldstart
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Fault-injection / resilience suite (tests marked `faults`): simulated

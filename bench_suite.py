@@ -909,10 +909,38 @@ def config18():
            "job_traces": trace_path})
 
 
+def config19():
+    """Cold-start elimination (ISSUE 20 / docs/design.md §31): the
+    persistent AOT executable cache measured where it matters — the
+    first-request latency of a FRESH PROCESS.  scripts/bench_coldstart
+    launches the same sharded workload twice against one QT_AOT_CACHE
+    directory (empty, then warm) in subprocesses; the second child must
+    deserialize instead of compiling.  Emits the uncached/cached
+    first-request ratio — higher is better, and a regression that
+    reintroduces the compile collapses it toward 1."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_coldstart as bc
+
+    t0 = time.perf_counter()
+    rec = bc.run(check=False)
+    _set_compile(rec["uncached_first_s"])  # the cost the cache removes
+    _emit(19, "cold start: fresh-process first-request speedup",
+          rec["value"], "coldstart_speedup_x",
+          round(time.perf_counter() - t0, 3),
+          {"uncached_first_s": rec["uncached_first_s"],
+           "cached_first_s": rec["cached_first_s"],
+           "cached_steady_s": rec["cached_steady_s"],
+           "cached_hits": rec["cached_aot"]["hits"],
+           "cached_puts": rec["cached_aot"]["puts"],
+           "bit_identical": rec["bit_identical"]})
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17, 18: config18}
+           15: config15, 16: config16, 17: config17, 18: config18,
+           19: config19}
 
 
 def main():

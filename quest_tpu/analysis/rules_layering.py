@@ -65,7 +65,7 @@ LAYER_OF = {
     "fusion": "orch", "batch": "orch", "circuit": "orch",
     "optimizer": "orch", "resilience": "orch", "checkpoint": "orch",
     "introspect": "orch", "governor": "orch",
-    "parallel": "dist",
+    "parallel": "dist", "aotcache": "dist",
     "ops": "ops",
     "env": "env",
 }

@@ -325,6 +325,18 @@ def get_environment_string(env: QuESTEnv) -> str:
     if cache["dir"]:
         s += (f" CompileCache={cache['dir']}"
               f"(hits={cache['hits']} misses={cache['misses']})")
+    # §31 persistent AOT executable tier — a distinct line from the XLA
+    # compile cache above: AotCache hits skip compilation ACROSS
+    # processes (deserialize), CompileCache hits dedup within one.
+    # Lazy import: env(rank 5) may not import dist-stratum modules at
+    # module level (analysis/rules_layering.py)
+    from . import aotcache as _aotcache
+
+    if _aotcache.enabled():
+        aot = _aotcache.stats()
+        s += (f" AotCache={aot['dir']}"
+              f"(hits={aot['hits']} misses={aot['misses']} "
+              f"puts={aot['puts']} bytes={aot['bytes']})")
     degraded = resilience.degradation_report()
     if degraded:
         s += " Degraded=[" + "; ".join(

@@ -714,6 +714,73 @@ def plan_items_quiet(qureg, items):
     return program, arrays, final_perm, nloc, nsh
 
 
+def aot_plan_info(qureg, items):
+    """Quiet planning PLUS the dispatch-key derivation _run_dispatch
+    applies (mesh / precision / exchange key / batch flag / channel-prob
+    slot count) — everything the AOT tier (§31) needs to name or prewarm
+    the executor a drain of ``items`` would dispatch, without touching
+    telemetry or the plan cache.  Returns None for an empty plan.
+
+    Single-group assumption: the prediction names the ungoverned
+    whole-program runner; a governor ladder split dispatches per-group
+    executors with their own (sub-program) keys."""
+    program, arrays, _fp, nloc, nsh = plan_items_quiet(qureg, items)
+    if not program:
+        return None
+    n = qureg.num_qubits_in_state_vec
+    bsz = int(getattr(qureg, "batch_size", 0) or 0)
+    mats_batched = False
+    if bsz:
+        perm0 = qureg._perm if nsh else None
+        oitems, _ostats = _opt.optimize_items(
+            items, n=n, nloc=nloc, nsh=nsh, perm0=perm0, quiet=True)
+        mats_batched = any(
+            not isinstance(it, ChannelItem)
+            and getattr(it.mat, "ndim", 0) == 4 for it in oitems)
+    if nsh:
+        from .parallel import dist as PAR
+
+        exchange_key = PAR.exchange_config_key()
+        mesh = qureg.env.mesh
+    else:
+        exchange_key = None
+        mesh = None
+    from .ops import fused as _fusedmod
+
+    ai = pi = 0
+    for part in program:
+        ai, pi = _part_advance(part, ai, pi)
+    return {
+        "program": program, "arrays": arrays, "nloc": nloc, "nsh": nsh,
+        "mesh": mesh, "precision": _fusedmod.matmul_precision_name(),
+        "exchange_key": exchange_key,
+        "batch_flag": (2 if mats_batched else 1) if bsz else 0,
+        "batch_size": bsz, "nprobs": pi, "final_perm": _fp,
+    }
+
+
+def aot_probe(qureg, items):
+    """Side-effect-free AOT-tier prediction for the drain ``items``
+    would dispatch — explainCircuit's ``compile`` section (§31).
+    Returns {"enabled", "status", "key"} with status in disabled /
+    uncacheable / memory / hit / miss."""
+    from . import aotcache as _aotcache
+
+    if not _aotcache.enabled():
+        return {"enabled": False, "status": "disabled", "key": None}
+    info = aot_plan_info(qureg, items)
+    if info is None:
+        return {"enabled": True, "status": "uncacheable", "key": None}
+    amps = _aotcache.amps_struct(
+        qureg.num_amps_total, info["batch_size"], qureg.dtype,
+        info["mesh"])
+    probs = tuple(0.5 for _ in range(info["nprobs"]))
+    sig = _aotcache.arg_sig(amps, info["arrays"], probs)
+    return _aotcache.probe(
+        info["nloc"], info["program"], info["mesh"], info["precision"],
+        info["exchange_key"], info["batch_flag"], sig)
+
+
 @lru_cache(maxsize=256)
 def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
                  exchange_key: str = None, batch: int = 0):
@@ -824,7 +891,15 @@ def _plan_runner(nloc: int, program: tuple, mesh, precision: str = None,
             check_vma=False,  # pallas_call inside shard_map has no vma info
         )(amps, *arrays, *probs)
 
-    return run
+    # §31 persistent AOT tier: with QT_AOT_CACHE set the runner is
+    # wrapped consult-before-compile / persist-on-miss (and gains the
+    # .prewarm entry point the serve warm pool drives); unset, this is
+    # an identity pass-through
+    from . import aotcache as _aotcache
+
+    return _aotcache.wrap_runner(
+        run, nloc=nloc, program=program, mesh=mesh, precision=precision,
+        exchange_key=exchange_key, batch=batch)
 
 
 def _shard_bits(qureg) -> int:

@@ -398,6 +398,20 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
         "exchange_chunks_key": str(PAR.exchange_config_key() or "auto"),
     }
 
+    # §31 AOT-tier prediction, computed on the SAME live plan key the
+    # drain will use (fusion.aot_probe replans quietly and hashes the
+    # full semantic identity): "memory" = an in-process executor is
+    # live (no disk consult, no counter moves), "hit"/"miss" = what the
+    # persistent tier will answer, "disabled"/"uncacheable" otherwise.
+    # Pinned drift-0 against the post-run aot_cache_* counters.
+    aot = F.aot_probe(qureg, orig_items)
+    compile_section = {
+        "aot": aot["status"],
+        "aot_enabled": aot["enabled"],
+        "aot_key": aot["key"],
+        "plan_cache": plan["cache"],
+    }
+
     read_exch = final_remap["exchanges"] if final_remap else 0
     read_bytes = final_remap["exchange_bytes"] if final_remap else 0
     # predicted per-device footprint of draining this stream — the
@@ -415,6 +429,7 @@ def explain_circuit(qureg, gates=None) -> ExplainReport:
         windows=windows,
         final_remap=final_remap,
         plan=plan,
+        compile=compile_section,
         optimizer=optimizer_section,
         memory=memory,
         totals={
@@ -452,6 +467,9 @@ def format_explain(report: dict) -> str:
     plan = report["plan"]
     head += (f", {report['items']} item(s), plan-cache={plan['cache']}, "
              f"chunks={plan['exchange_chunks_key']}")
+    comp = report.get("compile")
+    if comp and comp.get("aot") != "disabled":
+        head += f", aot={comp['aot']}"
     lines = [head]
     opt = report.get("optimizer")
     if opt:

@@ -108,6 +108,9 @@ Environment knobs (all optional, constructor args win):
   at bank completion — completion is always checked)
 - ``QT_SERVE_FLIGHT_DIR``   incident flight-record dump dir (default:
   ``<ckpt root>/flight``)
+- ``QT_SERVE_PREWARM``      1 = AOT-prewarm the observed hot
+  fingerprint set, including the shrunk-mesh variants elastic failover
+  would restore onto (default 0; needs ``QT_AOT_CACHE`` to persist)
 """
 
 from __future__ import annotations
@@ -168,6 +171,7 @@ _RETRIES_ENV = "QT_SERVE_RETRIES"
 _QUARANTINE_ENV = "QT_SERVE_QUARANTINE"
 _WATCHDOG_ENV = "QT_SERVE_WATCHDOG"
 _FLIGHT_DIR_ENV = "QT_SERVE_FLIGHT_DIR"
+_PREWARM_ENV = "QT_SERVE_PREWARM"
 
 # server serial numbers keep trace ids ("s<serial>-j<jid>") globally
 # unique across SimServer instances sharing one telemetry registry (the
@@ -419,6 +423,23 @@ def _env_int(var: str, default: int) -> int:
     return int(raw) if raw else default
 
 
+class _PlanStub:
+    """The minimal register-shaped object ``fusion.aot_plan_info``
+    needs: the prewarmer (§31) plans a bank's executor from analytic
+    parameters — no amplitudes are ever allocated for a warm-up."""
+
+    __slots__ = ("env", "num_qubits_in_state_vec", "_perm",
+                 "batch_size", "dtype", "num_amps_total")
+
+    def __init__(self, env: QuESTEnv, n: int, batch: int, dtype):
+        self.env = env
+        self.num_qubits_in_state_vec = n
+        self._perm = None  # banks (re)start drains from canonical order
+        self.batch_size = batch
+        self.dtype = np.dtype(dtype)
+        self.num_amps_total = 1 << n
+
+
 def _job_bytes_per_device(num_qubits: int, env: QuESTEnv,
                           is_density: bool, batch: int = 1) -> int:
     """Analytic per-device footprint of ``batch`` elements of an
@@ -455,7 +476,8 @@ class SimServer:
                  retries: Optional[int] = None,
                  quarantine: Optional[Tuple[int, float]] = None,
                  watchdog: Optional[int] = None,
-                 faults: Optional[_resilience.FaultPlan] = None):
+                 faults: Optional[_resilience.FaultPlan] = None,
+                 prewarm: Optional[bool] = None):
         self.env = env
         self.window = window if window is not None \
             else _env_int(_WINDOW_ENV, 16)
@@ -519,6 +541,20 @@ class SimServer:
         self.flight_dumps: List[str] = []
         self._http = None
         self._http_thread: Optional[threading.Thread] = None
+        # §31 warm pool: a daemon prewarmer AOT-compiles (or disk-loads)
+        # the executors for every observed bank fingerprint — on the
+        # live mesh AND the next failover's shrunk mesh — off the
+        # scheduling thread, so neither a fresh replica's first request
+        # nor a failover's first degraded drain pays an XLA compile
+        self.prewarm = bool(prewarm) if prewarm is not None \
+            else bool(_env_int(_PREWARM_ENV, 0))
+        self._warm_specs: Dict[tuple, dict] = {}  # dedup key -> spec
+        self._warm_keys: set = set()              # specs warmed so far
+        self._prewarm_q: List[dict] = []
+        self._prewarm_pending = 0
+        self._prewarm_lock = threading.Lock()
+        self._prewarm_wake = threading.Condition(self._prewarm_lock)
+        self._prewarm_thread: Optional[threading.Thread] = None
         _telemetry.set_gauge("serve_degraded", 0.0)
 
     # -- tenants ---------------------------------------------------------
@@ -714,6 +750,7 @@ class SimServer:
         _telemetry.set_gauge("serve_queue_depth", self._queued)
         self._publish_occupancy(bank)
         self._refresh_watermark()
+        self._warm_variants(bank)
 
     def _publish_occupancy(self, bank: _Bank) -> None:
         occ = _batch.bank_occupancy(bank.qureg, real=len(bank.jobs))
@@ -1166,6 +1203,16 @@ class SimServer:
                 self._dissolve(bank, err, reason="failover",
                                charge=False)
         self.env = new_env
+        # keep the warm pool one failover ahead: the executors for THIS
+        # mesh were prewarmed at bank start; queue the next shrink level
+        # so a second loss stays compile-free too
+        if self.prewarm:
+            with self._prewarm_lock:
+                known = list(self._warm_specs.values())
+            for spec in known:
+                nxt = dict(spec)
+                nxt["ndev"] = max(1, new_n // 2)
+                self._enqueue_prewarm(nxt)
         _ptopo.notify_mesh_event("serve_failover", from_devices=old_n,
                                  to_devices=new_n, dead_host=dead_host)
         _resilience.record_degradation(
@@ -1338,6 +1385,162 @@ class SimServer:
             tid = str(job)
         return _telemetry.tracez(tid)
 
+    # -- warm pool (§31) -------------------------------------------------
+
+    def _warm_variants(self, bank) -> None:
+        """Queue this bank's executor family for AOT prewarm: the live
+        mesh AND the half-mesh the next failover would shrink onto, so
+        neither a fresh replica's first request nor a failover's first
+        degraded drain pays an XLA compile."""
+        if not self.prewarm or bank.qureg is None:
+            return
+        q = bank.qureg
+        ndev = self.env.num_devices
+        for nd in dict.fromkeys((ndev, max(1, ndev // 2))):
+            self._enqueue_prewarm({
+                "v": 1, "items": list(bank.items), "sfp": bank.sfp,
+                "n": q.num_qubits_in_state_vec, "batch": bank.B,
+                "dtype": str(np.dtype(q.dtype)), "ndev": int(nd),
+            })
+
+    def _enqueue_prewarm(self, spec: dict) -> bool:
+        """Deduplicated enqueue onto the prewarmer thread (started
+        lazily — a server that never sees QT_SERVE_PREWARM work never
+        owns a thread).  Returns True when the spec was new."""
+        key = (spec.get("sfp"), int(spec["n"]), int(spec["batch"]),
+               str(spec["dtype"]), int(spec["ndev"]))
+        with self._prewarm_lock:
+            if key in self._warm_specs or self._closed:
+                return False
+            self._warm_specs[key] = spec
+            self._prewarm_q.append((key, spec))
+            self._prewarm_pending += 1
+            _telemetry.set_gauge("serve_prewarm_backlog",
+                                 float(self._prewarm_pending))
+            if self._prewarm_thread is None:
+                self._prewarm_thread = threading.Thread(
+                    target=self._prewarm_loop, name="qt-serve-prewarm",
+                    daemon=True)
+                self._prewarm_thread.start()
+            self._prewarm_wake.notify_all()
+        return True
+
+    def _prewarm_loop(self) -> None:
+        while True:
+            with self._prewarm_lock:
+                while not self._prewarm_q and not self._closed:
+                    self._prewarm_wake.wait(0.1)
+                if self._closed:
+                    return
+                key, spec = self._prewarm_q.pop(0)
+            try:
+                status = self._prewarm_one(spec)
+            # qlint: allow(broad-except): a failed warm-up must never hurt the serving thread — the executor just compiles lazily at first dispatch instead
+            except Exception:
+                status = "error"
+            with self._prewarm_lock:
+                self._prewarm_pending -= 1
+                if status in ("compiled", "hit", "present"):
+                    self._warm_keys.add(key)
+                _telemetry.set_gauge("serve_prewarm_backlog",
+                                     float(self._prewarm_pending))
+                _telemetry.set_gauge("serve_warm_pool_depth",
+                                     float(len(self._warm_keys)))
+                self._prewarm_wake.notify_all()
+            _telemetry.inc("serve_prewarm_total", status=status)
+
+    def _prewarm_one(self, spec: dict) -> str:
+        """Plan and AOT-materialize one bank spec's window executors —
+        exactly the window sequence a WindowExecutor would dispatch
+        (same checkpoint boundaries, optimizer suppressed, the live
+        permutation threaded window to window), so the live drains hit
+        what this thread warmed."""
+        from . import aotcache as _aotcache
+        from . import fusion as F
+        from . import optimizer as _opt
+
+        if not _aotcache.enabled():
+            return "disabled"
+        ndev = int(spec["ndev"])
+        env = self.env
+        if ndev != env.num_devices:
+            if (env.mesh is None or ndev < 1
+                    or env.num_devices % ndev):
+                return "skipped"
+            env = shrink_env(env, ndev)
+        stub = _PlanStub(env, int(spec["n"]), int(spec["batch"]),
+                         spec["dtype"])
+        items = list(spec["items"])
+        bounds = C.plan_checkpoint_boundaries(len(items), self.window)
+        statuses = set()
+        cursor = 0
+        with _opt.suppressed():
+            for end in bounds:
+                window_items = items[cursor:end]
+                cursor = end
+                info = F.aot_plan_info(stub, list(window_items))
+                if info is None:
+                    continue
+                runner = F._plan_runner(
+                    info["nloc"], info["program"], info["mesh"],
+                    info["precision"], info["exchange_key"],
+                    info["batch_flag"])
+                if not hasattr(runner, "prewarm"):
+                    return "disabled"
+                amps = _aotcache.amps_struct(
+                    stub.num_amps_total, stub.batch_size, stub.dtype,
+                    info["mesh"])
+                probs = tuple(0.5 for _ in range(info["nprobs"]))
+                statuses.add(runner.prewarm(amps, info["arrays"], probs))
+                fp = info["final_perm"]
+                if (info["nsh"] and fp is not None
+                        and list(fp) != list(range(stub.num_qubits_in_state_vec))):
+                    stub._perm = tuple(fp)
+                else:
+                    stub._perm = None
+        if not statuses:
+            return "empty"
+        for s in ("compiled", "hit", "present"):
+            if s in statuses:
+                return s
+        return statuses.pop()
+
+    def prewarm_join(self, timeout: float = 60.0) -> bool:
+        """Block until the prewarm queue drains (replica boot, tests).
+        Returns False on timeout."""
+        deadline = time.perf_counter() + float(timeout)
+        with self._prewarm_lock:
+            while self._prewarm_pending > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._prewarm_wake.wait(min(left, 0.1))
+        return True
+
+    def export_warmset(self) -> List[dict]:
+        """The observed hot fingerprint set as picklable specs.  Ship
+        to a fresh replica's :meth:`warm_from` so it boots hot: with a
+        shared ``QT_AOT_CACHE`` volume the executables travel as disk
+        hits; without one the replica AOT-compiles off-thread before
+        its first request instead of during it."""
+        with self._prewarm_lock:
+            return [dict(s) for s in self._warm_specs.values()]
+
+    def warm_from(self, warmset: Sequence[dict]) -> int:
+        """Adopt another replica's exported warm set; every spec is
+        queued for prewarm against THIS server's mesh family (a spec
+        from a bigger mesh warms our live size instead).  Returns the
+        number of new specs queued."""
+        count = 0
+        for spec in warmset:
+            spec = dict(spec)
+            if int(spec.get("ndev", 0)) > self.env.num_devices \
+                    or int(spec.get("ndev", 0)) < 1:
+                spec["ndev"] = self.env.num_devices
+            if self._enqueue_prewarm(spec):
+                count += 1
+        return count
+
     def _healthz(self) -> dict:
         """Health snapshot behind ``/healthz``.  stats() iterates live
         dicts the scheduling thread mutates; a concurrent resize raises
@@ -1364,6 +1567,8 @@ class SimServer:
             "completed": int(s.get("completed", 0)),
             "open_breakers": breakers,
             "flight_dumps": len(self.flight_dumps),
+            "warm_pool_depth": len(self._warm_keys),
+            "prewarm_backlog": int(self._prewarm_pending),
         }
 
     def serve_http(self, host: str = "127.0.0.1",
@@ -1481,6 +1686,12 @@ class SimServer:
         if self._closed:
             return
         self._closed = True
+        t = self._prewarm_thread
+        if t is not None:
+            with self._prewarm_lock:
+                self._prewarm_wake.notify_all()
+            t.join(timeout=5.0)
+            self._prewarm_thread = None
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()

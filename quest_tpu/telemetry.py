@@ -246,6 +246,12 @@ HIST_BOUNDS = {
     # end gets the same extra resolution as exchange latency
     "serve_queue_wait_seconds": (1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0,
                                  60.0),
+    # first dispatch through a freshly-traced executor (§31 AOT cache,
+    # labeled fingerprint_cached=true/false): cached first requests sit
+    # near steady-state (ms..100ms), uncached ones in the compile
+    # decades (seconds..minutes) — both ends need resolution
+    "first_request_seconds": (1e-3, 1e-2, 1e-1, 0.5, 1.0, 5.0, 15.0,
+                              60.0, 300.0),
 }
 
 
@@ -677,6 +683,24 @@ def _series():
     # qlint: allow(broad-except): same teardown window as the cache-stats absorb above — the snapshot drops the series rather than raising
     except Exception:  # pragma: no cover
         pass
+    try:
+        # §31 AOT tier (satellite 6): folded as its own aot_cache_*
+        # namespace so the persistent-executable tier stays
+        # distinguishable from XLA's process-local compile_cache_* —
+        # the two answer different questions (deserialize-vs-compile
+        # across processes vs jit dedup within one)
+        from . import aotcache as _aotcache
+
+        a = _aotcache._STATS
+        if _aotcache.enabled() or any(a.values()):
+            for nm in ("hits", "misses", "puts", "evictions", "errors"):
+                c[(f"aot_cache_{nm}_total", ())] = float(a[nm])
+            c[("aot_compile_seconds_saved_total", ())] = float(
+                a["saved_seconds"])
+            g[("aot_cache_bytes", ())] = float(a["bytes"])
+    # qlint: allow(broad-except): same teardown window as the cache-stats absorb above — the snapshot drops the series rather than raising
+    except Exception:  # pragma: no cover
+        pass
     return c, g, h
 
 
@@ -808,14 +832,22 @@ def prometheus_text() -> str:
 
 def summary() -> str:
     """One compact line for getEnvironmentString's ``[telemetry: ...]``
-    block: the mode plus every counter total aggregated over labels."""
+    block: the mode plus every counter total aggregated over labels.
+    Consolidates the folded cache tiers too (compile_cache_* = XLA's
+    process-local jit cache, aot_cache_* = the §31 persistent
+    executable tier) so the two stay distinguishable; zero-valued
+    totals are dropped — the folds inject their series unconditionally
+    and an all-zero tier is noise here."""
     if not _mode:
         return "off"
     totals: dict = {}
-    for (name, _labels), v in _COUNTERS.items():
+    counters, _gauges, _hists = _series()
+    for (name, _labels), v in counters.items():
         totals[name] = totals.get(name, 0) + v
     parts = [mode_name()]
     for name in sorted(totals):
+        if not totals[name]:
+            continue
         short = name[:-6] if name.endswith("_total") else name
         parts.append(f"{short}={_num(totals[name])}")
     return " ".join(parts)
@@ -1010,6 +1042,41 @@ def perf_report(env=None) -> str:
         mttr = gauge_max("serve_failover_mttr_seconds")
         if mttr is not None:
             lines.append(f"  failover_mttr_seconds={mttr:.4g}")
+    # §31 AOT executable cache + serve warm pool: the persistent tier's
+    # consult/persist history, the compile seconds its hits avoided,
+    # and the prewarmer's pool depth/backlog — counter reads via
+    # _series' aotcache fold, so the block also appears when the tier
+    # ran with telemetry off for part of the process lifetime
+    aot_h = counter_total("aot_cache_hits_total")
+    aot_m = counter_total("aot_cache_misses_total")
+    aot_p = counter_total("aot_cache_puts_total")
+    aot_e = counter_total("aot_cache_errors_total")
+    if aot_h or aot_m or aot_p or aot_e:
+        lines.append("AOT cache / warm pool (§31):")
+        lines.append(
+            f"  executables: hits={_num(aot_h)} misses={_num(aot_m)} "
+            f"puts={_num(aot_p)} "
+            f"evictions={_num(counter_total('aot_cache_evictions_total'))} "
+            f"errors={_num(aot_e)}")
+        size = gauge_max("aot_cache_bytes")
+        saved = counter_total("aot_compile_seconds_saved_total")
+        lines.append(
+            f"  bytes={_num(size or 0)} "
+            f"compile_seconds_saved={saved:.4g}")
+        depth = gauge_max("serve_warm_pool_depth")
+        backlog = gauge_max("serve_prewarm_backlog")
+        if depth is not None or backlog is not None:
+            lines.append(
+                f"  warm pool: depth={_num(depth or 0)} "
+                f"peak_backlog={_num(backlog or 0)} "
+                f"prewarms={_num(counter_total('serve_prewarm_total'))}")
+        first = snap["histograms"].get("first_request_seconds", {})
+        for labels, hd in sorted(first.items()):
+            mean = hd["sum"] / hd["count"] if hd["count"] else 0.0
+            lines.append(
+                f"  first_request_seconds{{{labels}}}: "
+                f"count={hd['count']} mean={mean:.6g} "
+                f"max={hd['max'] if hd['max'] is not None else '-'}")
     # §30 observability surfaces: flight-ring occupancy / dump history
     # and the request-trace store (the /tracez population)
     fl = len(_FLIGHT)

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Cold-start elimination bench + gate (docs/design.md §31).
+
+Measures what the persistent AOT executable cache actually buys: the
+first-request latency of a FRESH PROCESS.  The parent launches the same
+child workload twice against one QT_AOT_CACHE directory:
+
+  run 1 (uncached)  empty cache — the child pays the full XLA compile
+                    on its first drain, and persists the executable;
+  run 2 (cached)    fresh process, warm disk — the first drain must
+                    deserialize instead of compiling.
+
+Each child reports its first-drain wall time, its steady-state drain
+time (same program structure, in-memory executor tier), its aot_cache_*
+counters, and an amplitude checksum.  The parent emits a bench_suite
+style record with ``coldstart_speedup_x = uncached.first /
+cached.first`` — higher is better; bench_regress treats it as a rate.
+
+``--check`` turns the run into the verify-coldstart gate:
+
+  - the cached child must HIT the disk tier (hits >= 1, puts == 0 —
+    a put would mean it silently recompiled);
+  - cached first-request <= 2x its own steady-state (plus a small
+    absolute slack for host timer noise) — cold start eliminated;
+  - cached first-request strictly below the uncached one;
+  - both children's amplitude checksums bit-identical — the
+    deserialized executable computes exactly what the compiled one did.
+
+Usage:
+  python scripts/bench_coldstart.py            # bench, print record
+  python scripts/bench_coldstart.py --check    # gate, exit 1 on fail
+  python scripts/bench_coldstart.py --child    # (internal) one process
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# workload: sharded (8-way) 10-qubit circuit, deep enough that XLA
+# compilation dominates a cold first drain on every host we run on
+N = 10
+DEPTH = 6
+STEADY_REPS = 3
+
+
+def _drain(qt, env, theta):
+    import numpy as np
+
+    q = qt.createQureg(N, env)
+    qt.startGateFusion(q)
+    for d in range(DEPTH):
+        for k in range(N):
+            qt.hadamard(q, k)
+            qt.rotateZ(q, k, theta + 0.1 * k + d)
+        for k in range(N - 1):
+            qt.controlledNot(q, k, k + 1)
+    qt.stopGateFusion(q)
+    return np.asarray(q.amps)
+
+
+def child() -> None:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import quest_tpu as qt
+    from quest_tpu import aotcache as A
+
+    qt.set_precision(2)
+    env = qt.createQuESTEnv()
+    t0 = time.perf_counter()
+    amps = _drain(qt, env, 0.3)
+    first = time.perf_counter() - t0
+    steady = float("inf")
+    for _ in range(STEADY_REPS):
+        t0 = time.perf_counter()
+        _drain(qt, env, 0.3)
+        steady = min(steady, time.perf_counter() - t0)
+    print("CHILD " + json.dumps({
+        "first_s": round(first, 4),
+        "steady_s": round(steady, 4),
+        "aot": A.stats(),
+        "checksum": repr(float(np.sum(
+            amps * amps * np.arange(amps.size).reshape(amps.shape)))),
+    }), flush=True)
+
+
+def _run_child(cache_dir: str) -> dict:
+    env = dict(os.environ,
+               QT_AOT_CACHE=cache_dir,
+               PYTHONPATH=os.pathsep.join([REPO] + sys.path))
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        capture_output=True, text=True, timeout=900, env=env)
+    if out.returncode != 0:
+        sys.stderr.write(out.stderr)
+        raise SystemExit(f"coldstart child failed ({out.returncode})")
+    for line in out.stdout.splitlines():
+        if line.startswith("CHILD "):
+            return json.loads(line[len("CHILD "):])
+    raise SystemExit("coldstart child emitted no report:\n" + out.stdout)
+
+
+def run(check: bool = False) -> dict:
+    cache_dir = tempfile.mkdtemp(prefix="qt_coldstart_aot_")
+    t0 = time.perf_counter()
+    try:
+        uncached = _run_child(cache_dir)
+        cached = _run_child(cache_dir)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    speedup = uncached["first_s"] / max(cached["first_s"], 1e-9)
+    rec = {
+        "config": "coldstart",
+        "metric": "coldstart_speedup_x",
+        "value": round(speedup, 2),
+        "unit": "x_first_request",
+        "seconds": round(time.perf_counter() - t0, 3),
+        "uncached_first_s": uncached["first_s"],
+        "cached_first_s": cached["first_s"],
+        "cached_steady_s": cached["steady_s"],
+        "uncached_aot": uncached["aot"],
+        "cached_aot": cached["aot"],
+        "bit_identical": uncached["checksum"] == cached["checksum"],
+    }
+    print(json.dumps(rec), flush=True)
+    if check:
+        fails = []
+        if cached["aot"]["hits"] < 1:
+            fails.append("cached child never hit the disk tier")
+        if cached["aot"]["puts"] != 0:
+            fails.append("cached child recompiled (puts != 0)")
+        if uncached["aot"]["puts"] < 1:
+            fails.append("uncached child persisted nothing")
+        # cold start eliminated: first request within 2x steady state
+        # plus a 1s absolute allowance for the one-time executable
+        # deserialization — on the CPU CI arm a steady drain is ~50ms
+        # while deserialize_and_load of the persisted executable is
+        # ~0.5s, so a pure-relative bound would gate on deserialization
+        # speed rather than on compile avoidance.  A regression that
+        # reintroduces the compile (3s+ here) still fails this bound.
+        if cached["first_s"] > 2.0 * cached["steady_s"] + 1.0:
+            fails.append(
+                f"cached first request {cached['first_s']}s exceeds "
+                f"2x steady state {cached['steady_s']}s + deserialize "
+                f"allowance")
+        if cached["first_s"] >= 0.5 * uncached["first_s"]:
+            fails.append("cached first request not ≫ faster than "
+                         "uncached (compile not avoided?)")
+        if not rec["bit_identical"]:
+            fails.append("cached run not bit-identical to compiled run")
+        if fails:
+            for f in fails:
+                print("FAIL coldstart:", f, file=sys.stderr)
+            raise SystemExit(1)
+        print("verify-coldstart OK: first request "
+              f"{uncached['first_s']}s cold -> {cached['first_s']}s "
+              f"warm ({rec['value']}x), steady {cached['steady_s']}s")
+    return rec
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        child()
+        return
+    run(check="--check" in sys.argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,16 @@ The acceptance invariants asserted per seed (docs/design.md §27):
     ``SimServer.tracez`` as a COMPLETE well-nested span tree — admit,
     bank_join, at least one executed window, then complete, in that
     order — with the retry visible for every job the chaos killed and
-    re-ran.
+    re-ran;
+(g) warm pool one failover ahead (docs/design.md §31): the whole
+    harness runs with QT_AOT_CACHE + prewarm enabled, so the chaos
+    arm's deserialized executables must stay bit-identical to the
+    baseline's compiled ones (covered by (a)); after the run the
+    prewarm backlog must be drained, and when the chaos arm ends on a
+    degraded mesh its post-failover device count must already be
+    covered by a prewarmed warm-set variant — the shrunk-mesh
+    executable the failover restored onto never waits on a fresh XLA
+    compile, keeping MTTR flat.
 
 Usage: python scripts/chaos_serve.py [--seeds 11,12,37]
 Exits non-zero on any violated invariant; emits one JSON line per seed
@@ -150,7 +159,8 @@ def _run(env, jobs_spec, plan_spec):
     # pinned by tests/test_serve_resilience.py instead
     server = S.SimServer(env, window=WINDOW, max_batch=4, retries=4,
                          watchdog=1,
-                         quarantine=(100, 3600.0), faults=plan)
+                         quarantine=(100, 3600.0), faults=plan,
+                         prewarm=True)
     handles = []
     try:
         # submit in waves with steps between them: arrivals interleave
@@ -164,6 +174,13 @@ def _run(env, jobs_spec, plan_spec):
                     server.step()
         steps = server.run_until_idle(max_steps=STEP_BOUND)
         stats = server.stats()
+        warm = {
+            "joined": server.prewarm_join(timeout=120.0),
+            "healthz": {k: server._healthz()[k]
+                        for k in ("warm_pool_depth", "prewarm_backlog")},
+            "ndevs": sorted({spec["ndev"]
+                             for spec in server.export_warmset()}),
+        }
         out = {}
         for h in handles:
             out[h.id] = {
@@ -179,7 +196,7 @@ def _run(env, jobs_spec, plan_spec):
             }
         dumps = _load_dumps(server.flight_dumps)
         traces = {h.id: server.tracez(h) for h in handles}
-        return out, stats, steps, plan, dumps, traces
+        return out, stats, steps, plan, dumps, traces, warm
     finally:
         server.close()
 
@@ -189,13 +206,13 @@ def run_seed(seed):
     R.seed_backoff_jitter([seed])
     env = qt.createQuESTEnv()
     qt.seedQuEST(env, [seed])
-    base, base_stats, base_steps, _, _, _ = _run(env, _trace(seed), "")
+    base, base_stats, base_steps, _, _, _, _ = _run(env, _trace(seed), "")
 
     R.seed_backoff_jitter([seed])
     env = qt.createQuESTEnv()
     qt.seedQuEST(env, [seed])
     plan_spec, poisoned = _schedule(seed)
-    chaos, stats, steps, plan, dumps, traces = _run(
+    chaos, stats, steps, plan, dumps, traces, warm = _run(
         env, _trace(seed), plan_spec)
 
     violations = []
@@ -276,10 +293,25 @@ def run_seed(seed):
             violations.append(
                 f"job {j}: {chaos[j]['attempts']} attempts but no "
                 f"serve.retry in its trace")
+    # (g) warm pool one failover ahead: backlog drained, and a degraded
+    # end state was already covered by a prewarmed shrunk-mesh variant
+    from quest_tpu import aotcache as A
+    if A.enabled():
+        if not warm["joined"] or warm["healthz"]["prewarm_backlog"]:
+            violations.append(
+                f"prewarm backlog not drained ({warm})")
+        if warm["healthz"]["warm_pool_depth"] < 1:
+            violations.append("warm pool empty after chaos run")
+        if stats["degraded"] and stats["devices"] not in warm["ndevs"]:
+            violations.append(
+                f"degraded mesh ({stats['devices']} devices) has no "
+                f"prewarmed variant (warmset ndevs={warm['ndevs']}) — "
+                f"failover MTTR would pay a fresh compile")
 
     return {
         "seed": seed,
         "plan": plan_spec,
+        "warm_pool": warm,
         "violations": violations,
         "availability_pct": availability,
         "completed": len(completed),
@@ -299,14 +331,32 @@ def run_seed(seed):
 
 def run(seeds=(11, 12, 37)):
     """Entry point shared with bench_suite config 15."""
+    import shutil
+    import tempfile
+
+    from quest_tpu import aotcache as A
+
     t0 = time.perf_counter()
+    # the whole harness runs against one AOT cache directory with the
+    # serve warm pools on (invariant (g)): the baseline arm compiles
+    # and persists, the chaos arm deserializes — so bit-identity (a)
+    # doubles as the cached-executable determinism pin, and every
+    # failover lands on a prewarmed shrunk-mesh variant
+    own_cache = os.environ.get(A._DIR_ENV) is None
+    if own_cache:
+        os.environ[A._DIR_ENV] = tempfile.mkdtemp(prefix="qt_chaos_aot_")
     records = []
     ok = True
-    for seed in seeds:
-        rec = run_seed(int(seed))
-        records.append(rec)
-        ok = ok and not rec["violations"]
-        print(json.dumps(rec))
+    try:
+        for seed in seeds:
+            rec = run_seed(int(seed))
+            records.append(rec)
+            ok = ok and not rec["violations"]
+            print(json.dumps(rec))
+        aot = A.stats()
+    finally:
+        if own_cache:
+            shutil.rmtree(os.environ.pop(A._DIR_ENV), ignore_errors=True)
     mttr = T.gauge_max("serve_failover_mttr_seconds")
     agg = {
         "seeds": list(map(int, seeds)),
@@ -320,6 +370,8 @@ def run(seeds=(11, 12, 37)):
         "bank_retries": int(T.counter_total("serve_bank_retries_total")),
         "quarantined": int(
             T.counter_total("serve_jobs_quarantined_total")),
+        "aot_cache": {k: aot[k] for k in
+                      ("hits", "misses", "puts", "errors")},
         "seconds": round(time.perf_counter() - t0, 3),
     }
     print(json.dumps({"aggregate": agg}))

